@@ -1,0 +1,42 @@
+"""distkeras_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up re-design of the capabilities of ``weiboai/dist-keras`` (a
+Spark + Keras parameter-server framework; see SURVEY.md) for TPU hardware:
+
+- The socket-based parameter-server pull/commit loop (reference:
+  ``distkeras/parameter_servers.py``, ``distkeras/networking.py``) becomes
+  XLA collectives (``psum``/``all_gather``) over an ICI device mesh, driven
+  by ``jax.shard_map``.
+- Keras model definitions (reference: serialized via
+  ``distkeras/utils.py :: serialize_keras_model``) become Flax modules with
+  a registry-backed architecture+weights serialization of the same shape.
+- The Spark RDD data plane (reference: ``rdd.mapPartitionsWithIndex``)
+  becomes a host-sharded columnar ``Dataset`` feeding device-sharded
+  batches.
+- Spark-ML-style predictors/transformers/evaluators (reference:
+  ``distkeras/predictors.py``, ``transformers.py``, ``evaluators.py``)
+  become jit'd pure functions over the columnar ``Dataset``.
+
+Public API mirrors the reference's trainer surface:
+``SingleTrainer``, ``ADAG``, ``DOWNPOUR``, ``AEASGD``, ``EAMSGD``,
+``DynSGD``, ``AveragingTrainer``, ``EnsembleTrainer``.
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.trainers import (  # noqa: F401
+    Trainer,
+    SingleTrainer,
+    DistributedTrainer,
+    ADAG,
+    DOWNPOUR,
+    AEASGD,
+    EAMSGD,
+    DynSGD,
+    AveragingTrainer,
+    EnsembleTrainer,
+)
+from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.models.base import Model, ModelSpec  # noqa: F401
+from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
+from distkeras_tpu.evaluators import AccuracyEvaluator  # noqa: F401

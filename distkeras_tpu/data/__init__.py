@@ -1,0 +1,14 @@
+"""Data plane: columnar Dataset + feature/label transformers.
+
+Replaces the reference's Spark DataFrame/RDD machinery (SURVEY.md §2.14):
+rows live in host numpy columns, batches are device-sharded dicts.
+"""
+
+from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.transformers import (  # noqa: F401
+    OneHotTransformer,
+    MinMaxTransformer,
+    ReshapeTransformer,
+    DenseTransformer,
+    LabelIndexTransformer,
+)

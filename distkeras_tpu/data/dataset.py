@@ -1,0 +1,94 @@
+"""Columnar in-memory Dataset — the Spark-DataFrame stand-in.
+
+Reference parity: trainers consumed a Spark ``DataFrame`` with
+``features_col``/``label_col`` string-named columns, repartitioned it over
+workers, and iterated partitions row-by-row inside executors
+(``distkeras/workers.py``).  TPU-native design: columns are contiguous
+host numpy arrays (no row objects, no JVM), batching is a zero-copy slice,
+and "repartitioning over workers" becomes device-sharding the leading batch
+axis over a mesh axis — the data plane feeds the chips directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu import utils
+
+
+class Dataset:
+    """A dict of equal-length numpy columns with DataFrame-ish helpers."""
+
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        lengths = {k: len(v) for k, v in columns.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"column length mismatch: {lengths}")
+        self._columns = {k: np.asarray(v) for k, v in columns.items()}
+
+    # -- DataFrame-ish surface -------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    def __len__(self) -> int:
+        return len(next(iter(self._columns.values()))) if self._columns else 0
+
+    def __getitem__(self, col: str) -> np.ndarray:
+        return self._columns[col]
+
+    def with_column(self, name: str, values: np.ndarray) -> "Dataset":
+        if len(values) != len(self):
+            raise ValueError(f"new column {name!r} has {len(values)} rows, dataset has {len(self)}")
+        cols = dict(self._columns)
+        cols[name] = np.asarray(values)
+        return Dataset(cols)
+
+    def select(self, names: Sequence[str]) -> "Dataset":
+        return Dataset({n: self._columns[n] for n in names})
+
+    def take(self, n: int) -> "Dataset":
+        return Dataset({k: v[:n] for k, v in self._columns.items()})
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        """Row shuffle (reference: ``utils.shuffle`` before repartitioning)."""
+        return Dataset(utils.shuffle_arrays(self._columns, seed=seed))
+
+    def split(self, fraction: float, seed: Optional[int] = None) -> Sequence["Dataset"]:
+        """Random (train, test)-style split; reference: ``df.randomSplit``."""
+        ds = self.shuffle(seed) if seed is not None else self
+        cut = int(len(ds) * fraction)
+        left = Dataset({k: v[:cut] for k, v in ds._columns.items()})
+        right = Dataset({k: v[cut:] for k, v in ds._columns.items()})
+        return left, right
+
+    # -- batch plane -----------------------------------------------------------
+    def batches(self, batch_size: int, columns: Optional[Sequence[str]] = None,
+                drop_remainder: bool = True) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield batch dicts of the requested columns."""
+        names = list(columns) if columns is not None else self.columns
+        n = len(self)
+        end = (n // batch_size) * batch_size if drop_remainder else n
+        for i in range(0, end, batch_size):
+            yield {c: self._columns[c][i : i + batch_size] for c in names}
+
+    def stacked_epoch(self, batch_size: int, columns: Sequence[str],
+                      window: int = 1) -> Dict[str, np.ndarray]:
+        """Materialize one epoch as [num_windows, window, batch, ...] arrays.
+
+        This is the TPU-friendly feed shape: a whole epoch (or a large chunk)
+        becomes one device transfer and the train loop runs as a compiled
+        ``lax.scan`` over windows instead of a Python batch loop — the
+        replacement for the reference's per-row partition iterators.
+        """
+        per_window = batch_size * window
+        num_windows = len(self) // per_window
+        if num_windows == 0:
+            raise ValueError(
+                f"dataset of {len(self)} rows too small for batch_size={batch_size} window={window}")
+        out = {}
+        for c in columns:
+            v = self._columns[c][: num_windows * per_window]
+            out[c] = v.reshape((num_windows, window, batch_size) + v.shape[1:])
+        return out

@@ -1,0 +1,44 @@
+"""Evaluators (reference parity: ``distkeras/evaluators.py``).
+
+Reference: ``AccuracyEvaluator(prediction_col, label_col).evaluate(df)``
+computed classification accuracy by comparing two DataFrame columns.
+Here the comparison is one jit'd reduction over whole columns.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Evaluator:
+    def evaluate(self, dataset: Dataset) -> float:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where prediction matches label.
+
+    Accepts class-index columns, one-hot/probability-vector columns, or a
+    mix (vectors are argmax'd) — covering both the reference usage
+    (``LabelIndexTransformer`` output vs integer label) and direct logits.
+    """
+
+    def __init__(self, prediction_col: str = "prediction_index", label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+        def acc(pred, label):
+            if pred.ndim > 1:
+                pred = jnp.argmax(pred, axis=-1)
+            if label.ndim > 1:
+                label = jnp.argmax(label, axis=-1)
+            return jnp.mean((pred.astype(jnp.int32) == label.astype(jnp.int32)).astype(jnp.float32))
+
+        self._fn = jax.jit(acc)
+
+    def evaluate(self, dataset: Dataset) -> float:
+        return float(self._fn(jnp.asarray(dataset[self.prediction_col]), jnp.asarray(dataset[self.label_col])))

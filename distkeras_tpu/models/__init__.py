@@ -1,0 +1,16 @@
+"""Model zoo: registry-backed Flax architectures.
+
+Importing this package registers the built-in architectures (MLP, CNN,
+ResNet, TransformerLM) with the model registry used by serialization.
+"""
+
+from distkeras_tpu.models.base import (  # noqa: F401
+    Model,
+    ModelSpec,
+    register_model,
+    build_module,
+)
+import distkeras_tpu.models.mlp  # noqa: F401
+import distkeras_tpu.models.cnn  # noqa: F401
+import distkeras_tpu.models.resnet  # noqa: F401
+import distkeras_tpu.models.transformer  # noqa: F401

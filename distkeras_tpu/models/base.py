@@ -1,0 +1,151 @@
+"""Model abstraction: architecture registry + (spec, params) bundles.
+
+Reference parity: the reference moved Keras models around as
+``{architecture JSON, weight list}`` dicts (``distkeras/utils.py ::
+serialize_keras_model``) and rebuilt+compiled them inside each Spark
+executor (``distkeras/workers.py :: Worker.prepare_model``).  TPU-native
+equivalent: an architecture is a *registry name + config dict* that builds
+a Flax module deterministically, parameters are a pytree, and "compile"
+is ``jax.jit`` of the step function — there is no per-worker rebuild
+because SPMD replicas share one traced program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import utils
+
+_MODEL_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    """Class decorator registering a Flax module under an architecture name."""
+
+    def wrap(cls):
+        _MODEL_REGISTRY[name] = cls
+        cls.architecture_name = name
+        return cls
+
+    return wrap
+
+
+def build_module(name: str, config: Dict[str, Any]):
+    try:
+        cls = _MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown architecture {name!r}; known: {sorted(_MODEL_REGISTRY)}") from None
+    return cls(**config)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Declarative architecture record: registry name + config + input shape.
+
+    ``input_shape`` excludes the batch dimension (Keras convention).
+    """
+
+    name: str
+    config: Dict[str, Any]
+    input_shape: Tuple[int, ...]
+    input_dtype: str = "float32"
+
+    def __post_init__(self):
+        # canonicalize so a JSON round-trip (tuples -> lists) compares equal
+        def canon(v):
+            if isinstance(v, (list, tuple)):
+                return tuple(canon(x) for x in v)
+            return v
+
+        object.__setattr__(self, "config", {k: canon(v) for k, v in self.config.items()})
+        object.__setattr__(self, "input_shape", tuple(self.input_shape))
+
+    def build(self):
+        return build_module(self.name, self.config)
+
+    def init_params(self, seed: int = 0) -> Any:
+        module = self.build()
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), dtype=self.input_dtype)
+        variables = module.init(jax.random.PRNGKey(seed), dummy)
+        return variables["params"]
+
+    def apply_fn(self) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
+        module = self.build()
+
+        def apply(params: Any, x: jnp.ndarray) -> jnp.ndarray:
+            return module.apply({"params": params}, x)
+
+        return apply
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "config": dict(self.config),
+            "input_shape": list(self.input_shape),
+            "input_dtype": self.input_dtype,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ModelSpec":
+        return ModelSpec(
+            name=d["name"],
+            config=dict(d["config"]),
+            input_shape=tuple(d["input_shape"]),
+            input_dtype=d.get("input_dtype", "float32"),
+        )
+
+
+@dataclasses.dataclass
+class Model:
+    """A trained (or initialized) model: spec + parameter pytree.
+
+    This is what trainers return — the analogue of the Keras model object
+    the reference's ``Trainer.train`` handed back.
+    """
+
+    spec: ModelSpec
+    params: Any
+
+    @staticmethod
+    def init(spec: ModelSpec, seed: int = 0) -> "Model":
+        return Model(spec=spec, params=spec.init_params(seed))
+
+    def _jitted_apply(self):
+        # cached per instance: spec.apply_fn() returns a fresh closure each
+        # call, which would defeat jax's jit cache and recompile every time
+        cached = getattr(self, "_apply_cache", None)
+        if cached is None:
+            cached = jax.jit(self.spec.apply_fn())
+            object.__setattr__(self, "_apply_cache", cached)
+        return cached
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self._jitted_apply()(self.params, x)
+
+    def predict(self, x: np.ndarray, batch_size: int = 512) -> np.ndarray:
+        """Batched jit'd inference over a host array (see also ModelPredictor)."""
+        apply = self._jitted_apply()
+        outs = []
+        for i in range(0, len(x), batch_size):
+            outs.append(np.asarray(apply(self.params, jnp.asarray(x[i : i + batch_size]))))
+        return np.concatenate(outs, axis=0) if outs else np.zeros((0,))
+
+    def serialize(self) -> bytes:
+        return utils.serialize_model(self.spec.to_dict(), self.params)
+
+    @staticmethod
+    def deserialize(blob: bytes) -> "Model":
+        arch, weights = utils.deserialize_model(blob)
+        spec = ModelSpec.from_dict(arch)
+        template = spec.init_params(seed=0)
+        _, treedef = jax.tree.flatten(template)
+        params = utils.unflatten_weights(treedef, weights)
+        return Model(spec=spec, params=params)
+
+    def copy(self) -> "Model":
+        return Model(spec=self.spec, params=jax.tree.map(jnp.array, self.params))

@@ -1,0 +1,54 @@
+"""Small convnet (the reference's MNIST-CNN / CIFAR-CNN example family).
+
+NHWC layout throughout — XLA's preferred convolution layout on TPU (the
+MXU tiles the channel dim onto lanes).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import register_model
+
+
+@register_model("cnn")
+class CNN(nn.Module):
+    """Conv-relu-pool blocks then a dense head. Outputs logits."""
+
+    conv_channels: Sequence[int] = (32, 64)
+    kernel_size: int = 3
+    dense_size: int = 256
+    num_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for ch in self.conv_channels:
+            x = nn.Conv(ch, (self.kernel_size, self.kernel_size), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_size)(x))
+        return nn.Dense(self.num_outputs)(x)
+
+
+def mnist_cnn_spec():
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="cnn",
+        config={"conv_channels": (32, 64), "kernel_size": 3, "dense_size": 256, "num_outputs": 10},
+        input_shape=(28, 28, 1),
+    )
+
+
+def cifar_cnn_spec(num_outputs: int = 10):
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="cnn",
+        config={"conv_channels": (64, 128, 256), "kernel_size": 3, "dense_size": 512, "num_outputs": num_outputs},
+        input_shape=(32, 32, 3),
+    )

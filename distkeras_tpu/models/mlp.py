@@ -1,0 +1,36 @@
+"""MLP architecture (the reference's MNIST-MLP example model family).
+
+Reference parity: the reference's examples built Keras ``Sequential``
+Dense stacks (``examples/mnist.py``); here the equivalent is a registered
+Flax module so it round-trips through the architecture registry.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import register_model
+
+
+@register_model("mlp")
+class MLP(nn.Module):
+    """Dense stack: hidden layers with ReLU, linear head (logits out)."""
+
+    hidden_sizes: Sequence[int] = (500, 500)
+    num_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_outputs)(x)
+
+
+def mnist_mlp_spec():
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(name="mlp", config={"hidden_sizes": (500, 500), "num_outputs": 10}, input_shape=(784,))

@@ -1,0 +1,66 @@
+"""ResNet-20 (CIFAR variant) — the BASELINE.md config-5 model.
+
+Classic 3-stage CIFAR ResNet (He et al. 2015): 6n+2 layers with n=3.
+Uses GroupNorm instead of BatchNorm: batch statistics are a cross-replica
+dependency that would force an extra collective per norm layer on a TPU
+mesh and make the per-replica divergent-weights algorithms (EASGD family)
+ill-defined; GroupNorm is batch-independent, so every parallelism mode
+sees identical semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import register_model
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(self.channels, (3, 3), strides=(self.strides, self.strides), padding="SAME", use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(8, self.channels))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.channels))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.channels, (1, 1), strides=(self.strides, self.strides), use_bias=False)(x)
+        return nn.relu(y + residual)
+
+
+@register_model("resnet")
+class ResNet(nn.Module):
+    """CIFAR-style ResNet; depth = 6*blocks_per_stage + 2."""
+
+    blocks_per_stage: int = 3
+    base_channels: int = 16
+    num_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(self.base_channels, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=min(8, self.base_channels))(x)
+        x = nn.relu(x)
+        for stage, ch in enumerate([self.base_channels, self.base_channels * 2, self.base_channels * 4]):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = ResidualBlock(channels=ch, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_outputs)(x)
+
+
+def resnet20_spec(num_outputs: int = 100):
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="resnet",
+        config={"blocks_per_stage": 3, "base_channels": 16, "num_outputs": num_outputs},
+        input_shape=(32, 32, 3),
+    )

@@ -1,0 +1,106 @@
+"""Decoder-only TransformerLM — the framework's flagship long-context model.
+
+Not present in the reference (it predates transformers; SURVEY.md §5) —
+this is the TPU-native headroom model exercising the sequence-parallel
+(ring attention) and tensor-parallel paths.  Designed MXU-first: all
+matmuls are [*, model_dim] x [model_dim, *] with dims that tile 128 lanes;
+``param_dtype`` float32 with bfloat16 activations via ``compute_dtype``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import register_model
+from distkeras_tpu.ops.attention import attention
+
+
+class TransformerBlock(nn.Module):
+    model_dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    seq_axis: Optional[str] = None  # mesh axis name for ring attention
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        head_dim = self.model_dim // self.num_heads
+        y = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        qkv = nn.Dense(3 * self.model_dim, use_bias=False, dtype=self.compute_dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, l = q.shape[0], q.shape[1]
+        q = q.reshape(b, l, self.num_heads, head_dim)
+        k = k.reshape(b, l, self.num_heads, head_dim)
+        v = v.reshape(b, l, self.num_heads, head_dim)
+        o = attention(q, k, v, causal=True, axis_name=self.seq_axis)
+        o = o.reshape(b, l, self.model_dim)
+        x = x + nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="proj")(o)
+        y = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        y = nn.Dense(self.mlp_ratio * self.model_dim, use_bias=False, dtype=self.compute_dtype, name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="down")(y)
+        return x + y
+
+
+@register_model("transformer_lm")
+class TransformerLM(nn.Module):
+    """Causal LM over integer tokens [B, L] -> logits [B, L, vocab].
+
+    When ``seq_axis`` is set the module must be called under ``shard_map``
+    with the sequence dim sharded over that axis; position embeddings are
+    then indexed by global position (handled inside the block's ring
+    attention; the learned positional table here is sized for the *global*
+    sequence and sliced by the caller-provided offset).
+    """
+
+    vocab_size: int = 32000
+    model_dim: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    max_seq_len: int = 2048
+    mlp_ratio: int = 4
+    seq_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        b, l = tokens.shape
+        embed = nn.Embed(self.vocab_size, self.model_dim, dtype=self.compute_dtype, name="embed")
+        pos_table = self.param("pos_embed", nn.initializers.normal(0.02), (self.max_seq_len, self.model_dim))
+        x = embed(tokens)
+        pos = jnp.arange(l) + pos_offset
+        x = x + pos_table[pos].astype(self.compute_dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                model_dim=self.model_dim,
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                seq_axis=self.seq_axis,
+                compute_dtype=self.compute_dtype,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
+                  num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None):
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="transformer_lm",
+        config={
+            "vocab_size": vocab_size,
+            "model_dim": model_dim,
+            "num_heads": num_heads,
+            "num_layers": num_layers,
+            "max_seq_len": max_seq_len,
+            "seq_axis": seq_axis,
+        },
+        input_shape=(max_seq_len,),
+        input_dtype="int32",
+    )

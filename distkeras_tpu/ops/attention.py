@@ -1,0 +1,96 @@
+"""Attention ops: dense causal attention + ring attention (sequence parallel).
+
+The reference framework predates transformers and has no long-context
+machinery (SURVEY.md §5 "Long-context: absent entirely"); this module is
+TPU-native headroom, built first-class per the framework's scaling goals.
+
+Ring attention (Liu et al. 2023 pattern): shard the sequence over a mesh
+axis; each device holds a query block and streams key/value blocks around
+the ring with ``lax.ppermute``, accumulating softmax online (flash-style
+running max / denominator), so attention over a sequence of length L costs
+O(L/sp) memory per chip and the KV transfers ride the ICI ring.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True,
+                    q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
+    """Plain softmax attention. Shapes: q [B, Lq, H, D], k/v [B, Lk, H, D].
+
+    ``q_offset``/``k_offset`` are the global positions of the first query /
+    key element — needed when the caller holds only a shard of the sequence.
+    """
+    depth = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: str,
+                   causal: bool = True) -> jnp.ndarray:
+    """Sequence-parallel attention under ``shard_map`` over ``axis_name``.
+
+    Each caller holds the local sequence shard: q/k/v [B, L_local, H, D].
+    KV blocks rotate around the ring; the block held at step ``s`` is the
+    one that originated on rank ``(my_rank - s) mod sp``. Softmax is
+    accumulated online in float32 for stability.
+    """
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, l_local, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * l_local + jnp.arange(l_local)
+
+    def step(carry, s):
+        m, l_sum, acc, k_blk, v_blk = carry
+        src = (my - s) % sp  # global rank the current kv block came from
+        k_pos = src * l_local + jnp.arange(l_local)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+        blk_max = jnp.max(logits, axis=-1)  # [B,H,Lq]
+        new_m = jnp.maximum(m, blk_max)
+        # guard: fully-masked rows produce -inf max; keep exp well-defined
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+        probs = jnp.exp(logits - safe_m[..., None])  # [B,H,Lq,Lk]
+        new_l = l_sum * correction + jnp.sum(probs, axis=-1)
+        blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_blk.astype(jnp.float32))
+        new_acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
+        # rotate kv one hop around the ring (rank r -> r+1)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (new_m, new_l, new_acc, k_next, v_next), None
+
+    m0 = jnp.full((b, h, l_local), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, l_local), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, l_local, h, d), dtype=jnp.float32)
+    # accumulators become device-varying on the first scan step; mark them so
+    m0, l0, acc0 = (lax.pcast(x, (axis_name,), to="varying") for x in (m0, l0, acc0))
+    (m, l_sum, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(sp))
+    denom = jnp.maximum(l_sum, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None):
+    """Dispatch: ring attention when a sequence mesh axis is given, else dense."""
+    if axis_name is None:
+        return dense_attention(q, k, v, causal=causal)
+    return ring_attention(q, k, v, axis_name=axis_name, causal=causal)

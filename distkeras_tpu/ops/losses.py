@@ -1,0 +1,72 @@
+"""Loss registry with Keras-style string names.
+
+Reference parity: the reference passed Keras loss names (strings) into
+``Trainer(model, loss='categorical_crossentropy', ...)`` and compiled them
+into the worker's model (``workers.py :: Worker.prepare_model``).  Here each
+name maps to a pure ``loss(logits_or_preds, labels) -> scalar`` function
+that jit-compiles and differentiates cleanly on TPU.
+
+All losses reduce with a mean over the batch so gradient magnitudes are
+batch-size invariant (required for the window/commit algebra of the
+distributed trainers to match the reference's per-batch semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import optax
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def categorical_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax CE with one-hot labels (labels shape [..., num_classes])."""
+    return optax.softmax_cross_entropy(logits, labels).mean()
+
+
+def sparse_categorical_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax CE with integer labels (labels shape [...])."""
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == logits.ndim:  # tolerate a trailing singleton label dim
+        labels = labels.squeeze(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def binary_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Sigmoid CE on logits (numerically stable; do NOT pre-sigmoid)."""
+    return optax.sigmoid_binary_cross_entropy(logits, labels.astype(logits.dtype)).mean()
+
+
+def mean_squared_error(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.square(preds - targets.astype(preds.dtype)))
+
+
+def mean_absolute_error(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.abs(preds - targets.astype(preds.dtype)))
+
+
+_LOSSES: Dict[str, LossFn] = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+}
+
+
+def get_loss(name_or_fn) -> LossFn:
+    """Resolve a Keras-style loss name (or pass a callable through)."""
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _LOSSES[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown loss {name_or_fn!r}; known: {sorted(_LOSSES)}") from None
+
+
+def register_loss(name: str, fn: LossFn) -> None:
+    _LOSSES[name] = fn

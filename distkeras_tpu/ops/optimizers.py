@@ -1,0 +1,48 @@
+"""Optimizer registry with Keras-style names, backed by optax.
+
+Reference parity: the reference passed a Keras optimizer (string name or
+object) as the *worker optimizer* into every trainer; the parameter server
+applied raw deltas with no optimizer of its own.  The same split holds
+here: these optax transforms drive the *local* (per-replica) SGD steps,
+while the center/commit updates in ``distkeras_tpu.algorithms`` are plain
+arithmetic, exactly like the reference PS.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import optax
+
+
+def get_optimizer(spec: Union[str, optax.GradientTransformation], learning_rate: float = 0.01,
+                  momentum: Optional[float] = None) -> optax.GradientTransformation:
+    """Resolve a Keras-style optimizer name into an optax transform.
+
+    ``spec`` may already be an ``optax.GradientTransformation`` (returned
+    unchanged), or one of: ``sgd``, ``momentum``, ``nesterov``, ``adam``,
+    ``adamw``, ``adagrad``, ``rmsprop``, ``adadelta``.
+    """
+    if isinstance(spec, optax.GradientTransformation):
+        return spec
+    name = spec.lower()
+    # None means "use this optimizer's conventional default"; an explicit
+    # momentum=0.0 must be honored, so no falsy-zero shortcuts here
+    mom = 0.9 if momentum is None else momentum
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=mom)
+    if name == "nesterov":
+        return optax.sgd(learning_rate, momentum=mom, nesterov=True)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate)
+    raise ValueError(f"unknown optimizer {spec!r}")

@@ -1,0 +1,12 @@
+"""Parallelism layer: device meshes, collective update rules, window engine."""
+
+from distkeras_tpu.parallel.mesh import create_mesh  # noqa: F401
+from distkeras_tpu.parallel.algorithms import (  # noqa: F401
+    Algorithm,
+    AdagAlgorithm,
+    DownpourAlgorithm,
+    ElasticAlgorithm,
+    DynSGDAlgorithm,
+    NoCommitAlgorithm,
+)
+from distkeras_tpu.parallel.engine import ReplicaState, WindowEngine  # noqa: F401

@@ -1,0 +1,154 @@
+"""Distributed-optimization update rules as pure collective functions.
+
+Reference parity (SURVEY.md §2.5–2.9, §3.1, §3.5): each reference
+algorithm was a (worker, parameter-server) pair exchanging weight deltas
+over TCP — ``commit`` applied a delta to the center under a mutex, ``pull``
+fetched fresh center weights.  TPU-native re-expression: every replica runs
+``communication_window`` local minibatch steps, then the algorithm's
+*commit rule* runs as one XLA collective over the ``replica`` mesh axis.
+The hub-and-spoke socket round-trip collapses into a ``psum`` on ICI.
+
+Asynchrony note (the SURVEY §7 "hard part"): TPU collectives are
+synchronous, so the async protocols are realized as their *deterministic
+synchronous serializations* — every replica commits once per window, and
+staleness (DynSGD) is modeled by a fixed round-robin commit order within
+the window (replica r sees r prior commits, staleness = r).  This keeps
+the reference's update algebra bit-for-bit testable (see
+tests/test_algorithms.py) while removing the GIL-serialized mutex hub.
+
+Each rule is a pure function ``(center, local, extra) -> (center, local,
+extra)`` evaluated under ``shard_map``; ``center`` is mesh-invariant
+(replicated), ``local``/``extra`` are per-replica.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tree_psum(tree: Any, axis_name: str) -> Any:
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+class Algorithm:
+    """Commit-rule interface. Subclasses are stateless; per-replica state
+    beyond the weights goes in the ``extra`` pytree."""
+
+    name: str = "base"
+
+    def init_extra(self, params: Any) -> Dict[str, Any]:
+        return {}
+
+    def window_commit(self, center: Any, local: Any, extra: Dict[str, Any],
+                      axis_name: str) -> tuple:
+        raise NotImplementedError
+
+
+class AdagAlgorithm(Algorithm):
+    """ADAG — Asynchronous Distributed Adaptive Gradients (arXiv:1611.04581).
+
+    Reference: ``ADAGParameterServer.handle_commit`` scaled each incoming
+    windowed delta by 1/num_workers before adding it to the center
+    (staleness-compensating normalization).  Synchronous form: the center
+    advances by the *replica-mean* accumulated delta:
+
+        center' = center + (1/R) * sum_r (local_r - center)
+        local'  = center'            (the post-commit pull)
+    """
+
+    name = "adag"
+
+    def window_commit(self, center, local, extra, axis_name):
+        num = lax.psum(1, axis_name)
+        delta = jax.tree.map(lambda l, c: l - c, local, center)
+        mean_delta = jax.tree.map(lambda d: lax.psum(d, axis_name) / num, delta)
+        new_center = jax.tree.map(lambda c, d: c + d, center, mean_delta)
+        return new_center, new_center, extra
+
+
+class DownpourAlgorithm(Algorithm):
+    """DOWNPOUR (Dean et al. 2012).
+
+    Reference: workers accumulate raw gradient updates for
+    ``communication_window`` batches and commit the summed delta; the PS
+    (``DeltaParameterServer``) adds deltas *unscaled*.  Synchronous form:
+
+        center' = center + sum_r (local_r - center)
+        local'  = center'
+    """
+
+    name = "downpour"
+
+    def window_commit(self, center, local, extra, axis_name):
+        delta = jax.tree.map(lambda l, c: l - c, local, center)
+        sum_delta = _tree_psum(delta, axis_name)
+        new_center = jax.tree.map(lambda c, d: c + d, center, sum_delta)
+        return new_center, new_center, extra
+
+
+class ElasticAlgorithm(Algorithm):
+    """AEASGD / EAMSGD — (momentum) elastic averaging SGD (arXiv:1412.6651).
+
+    Reference worker window step (``AEASGDWorker.train``):
+
+        elastic_diff = alpha * (local - center)   # alpha = rho * lr
+        local  -= elastic_diff                    # spring pulls local inward
+        commit(elastic_diff)                      # PS: center += elastic_diff
+
+    Synchronous form: the center collects every replica's elastic force in
+    one psum. Locals stay divergent — the exploration property of EASGD.
+    EAMSGD differs only in the *local* optimizer (momentum/Nesterov), which
+    lives in the engine's optax state, so both share this commit rule.
+    """
+
+    name = "elastic"
+
+    def __init__(self, rho: float, learning_rate: float):
+        self.alpha = float(rho) * float(learning_rate)
+
+    def window_commit(self, center, local, extra, axis_name):
+        ediff = jax.tree.map(lambda l, c: self.alpha * (l - c), local, center)
+        new_local = jax.tree.map(lambda l, e: l - e, local, ediff)
+        sum_ediff = _tree_psum(ediff, axis_name)
+        new_center = jax.tree.map(lambda c, e: c + e, center, sum_ediff)
+        return new_center, new_local, extra
+
+
+class DynSGDAlgorithm(Algorithm):
+    """DynSGD — staleness-aware dynamic learning rate (arXiv:1611.04581).
+
+    Reference: ``DynSGDParameterServer.handle_commit`` kept a global update
+    clock and scaled each delta by ``1/(staleness+1)`` where staleness =
+    commits applied since that worker's pull.  Deterministic serialization:
+    replicas commit in rank order within the window, so replica r has
+    staleness r and the center advances by
+
+        center' = center + sum_r (local_r - center) / (r + 1)
+    """
+
+    name = "dynsgd"
+
+    def window_commit(self, center, local, extra, axis_name):
+        rank = lax.axis_index(axis_name)
+        scale = 1.0 / (rank.astype(jnp.float32) + 1.0)
+        scaled = jax.tree.map(lambda l, c: (l - c) * scale, local, center)
+        sum_scaled = _tree_psum(scaled, axis_name)
+        new_center = jax.tree.map(lambda c, d: c + d, center, sum_scaled)
+        return new_center, new_center, extra
+
+
+class NoCommitAlgorithm(Algorithm):
+    """No communication — replicas train independently for the whole run.
+
+    Backs ``AveragingTrainer`` (average locals once at the end) and
+    ``EnsembleTrainer`` (return all locals), reference §2.2/2.3.
+    """
+
+    name = "nocommit"
+
+    def window_commit(self, center, local, extra, axis_name):
+        return center, local, extra

@@ -1,0 +1,235 @@
+"""The window engine: compiled replacement for the Trainer/Worker/PS loop.
+
+Reference call stack being replaced (SURVEY.md §3.1): driver starts a PS
+thread, ships pickled workers to Spark executors, each worker loops
+``model.train_on_batch`` and every ``communication_window`` batches does a
+socket ``commit``/``pull`` round-trip to the driver.
+
+TPU-native shape: ONE jitted function per epoch —
+
+    shard_map over the 'replica' mesh axis of:
+        lax.scan over windows of:
+            lax.scan over the window's minibatches:  local optax step
+            algorithm.window_commit(...):            psum collective
+
+The whole epoch is a single XLA program: no Python in the hot loop, no
+host round-trips, the commit is an ICI allreduce fused into the step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.parallel.algorithms import Algorithm
+
+
+@struct.dataclass
+class ReplicaState:
+    """Global training state. ``local``/``opt_state``/``extra`` carry a
+    leading replica axis (sharded over the mesh); ``center`` is replicated —
+    it is the PS's "center variable" of the reference, now mesh-invariant."""
+
+    center: Any
+    local: Any
+    opt_state: Any
+    extra: Any
+    step: jnp.ndarray
+
+
+def _ensure_varying(x, axis_name: str):
+    """Mark ``x`` as varying over ``axis_name`` unless it already is."""
+    if axis_name in jax.typeof(x).vma:
+        return x
+    return lax.pcast(x, (axis_name,), to="varying")
+
+
+def make_loss_fn(apply_fn: Callable, loss: Callable) -> Callable:
+    def loss_of(params, batch_x, batch_y):
+        return loss(apply_fn(params, batch_x), batch_y)
+
+    return loss_of
+
+
+def make_minibatch_step(apply_fn: Callable, loss: Callable,
+                        optimizer: optax.GradientTransformation) -> Callable:
+    """One ``train_on_batch`` equivalent: value_and_grad + optax update."""
+    loss_of = make_loss_fn(apply_fn, loss)
+
+    def step(carry, batch):
+        params, opt_state = carry
+        loss_val, grads = jax.value_and_grad(loss_of)(params, batch[0], batch[1])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss_val
+
+    return step
+
+
+def scan_epoch_fn(apply_fn: Callable, loss: Callable,
+                  optimizer: optax.GradientTransformation) -> Callable:
+    """Single-device compiled epoch: lax.scan over [num_batches, bs, ...].
+
+    Backs ``SingleTrainer`` — the reference's minimal path (SURVEY §3.2)
+    with the per-row partition iterator replaced by one device transfer
+    and one XLA program per epoch.
+    """
+    mini = make_minibatch_step(apply_fn, loss, optimizer)
+
+    def epoch(params, opt_state, xs, ys):
+        (params, opt_state), losses = lax.scan(mini, (params, opt_state), (xs, ys))
+        return params, opt_state, losses
+
+    return jax.jit(epoch, donate_argnums=(0, 1))
+
+
+class WindowEngine:
+    """Builds and runs the sharded window-training program for one
+    (model spec, loss, optimizer, algorithm, mesh) combination."""
+
+    def __init__(self, spec: ModelSpec, loss: Callable,
+                 optimizer: optax.GradientTransformation, algorithm: Algorithm,
+                 mesh: Mesh, axis_name: str = "replica", window: int = 1):
+        self.spec = spec
+        self.loss = loss
+        self.optimizer = optimizer
+        self.algorithm = algorithm
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.window = int(window)
+        self.num_replicas = mesh.shape[axis_name]
+        self._apply = spec.apply_fn()
+        self._epoch_fn = self._build_epoch_fn()
+
+    # -- state ----------------------------------------------------------------
+    def _state_specs(self) -> ReplicaState:
+        return ReplicaState(
+            center=P(),
+            local=P(self.axis_name),
+            opt_state=P(self.axis_name),
+            extra=P(self.axis_name),
+            step=P(),
+        )
+
+    def _state_shardings(self) -> ReplicaState:
+        return jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self._state_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def init_state(self, model: Model, divergent_seeds: Optional[Sequence[int]] = None) -> ReplicaState:
+        """Replicate the model into per-replica locals + a shared center.
+
+        ``divergent_seeds`` gives each replica its own re-initialization
+        (EnsembleTrainer's decorrelation; reference ``uniform_weights``).
+        """
+        r = self.num_replicas
+        center = jax.tree.map(np.asarray, model.params)
+        if divergent_seeds is not None:
+            if len(divergent_seeds) != r:
+                raise ValueError(f"need {r} seeds, got {len(divergent_seeds)}")
+            locals_list = [
+                jax.tree.map(np.asarray, self.spec.init_params(seed=s)) for s in divergent_seeds
+            ]
+        else:
+            locals_list = [center] * r
+        local = jax.tree.map(lambda *xs: np.stack(xs), *locals_list)
+        opt0 = self.optimizer.init(model.params)
+        opt_np = jax.tree.map(np.asarray, opt0)
+        opt_state = jax.tree.map(lambda x: np.stack([x] * r), opt_np)
+        extra0 = self.algorithm.init_extra(model.params)
+        extra = jax.tree.map(lambda x: np.stack([np.asarray(x)] * r), extra0)
+        state = ReplicaState(center=center, local=local, opt_state=opt_state,
+                             extra=extra, step=np.zeros((), np.int32))
+        return jax.device_put(state, self._state_shardings())
+
+    # -- compiled epoch --------------------------------------------------------
+    def _build_epoch_fn(self) -> Callable:
+        algo = self.algorithm
+        axis = self.axis_name
+        mini = make_minibatch_step(self._apply, self.loss, self.optimizer)
+
+        def shard_fn(state: ReplicaState, xs, ys):
+            # per-shard views: strip the leading (sharded) replica axis
+            local = jax.tree.map(lambda a: a[0], state.local)
+            opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
+            extra = jax.tree.map(lambda a: a[0], state.extra)
+            center = state.center
+
+            def window_step(carry, window_batches):
+                center, local, opt_state, extra = carry
+                wx, wy = window_batches
+                (local, opt_state), losses = lax.scan(mini, (local, opt_state), (wx, wy))
+                center, local, extra = algo.window_commit(center, local, extra, axis)
+                # commit rules that reset local to the (mesh-invariant) center
+                # change the carry's varying-axes type; cast it back
+                local = jax.tree.map(lambda x: _ensure_varying(x, axis), local)
+                extra = jax.tree.map(lambda x: _ensure_varying(x, axis), extra)
+                mean_loss = lax.pmean(jnp.mean(losses), axis)
+                return (center, local, opt_state, extra), mean_loss
+
+            (center, local, opt_state, extra), window_losses = lax.scan(
+                window_step, (center, local, opt_state, extra), (xs, ys)
+            )
+            num_steps = xs.shape[0] * xs.shape[1]
+            new_state = ReplicaState(
+                center=center,
+                local=jax.tree.map(lambda a: a[None], local),
+                opt_state=jax.tree.map(lambda a: a[None], opt_state),
+                extra=jax.tree.map(lambda a: a[None], extra),
+                step=state.step + jnp.int32(num_steps),
+            )
+            return new_state, window_losses
+
+        specs = self._state_specs()
+        data_spec = P(None, None, axis)
+        sharded = jax.shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(specs, P()),
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def data_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(None, None, self.axis_name))
+
+    def run_epoch(self, state: ReplicaState, xs: np.ndarray, ys: np.ndarray):
+        """xs/ys: [num_windows, window, global_batch, ...] host arrays.
+
+        Returns (new_state, per-window mean losses as numpy).
+        """
+        sharding = self.data_sharding()
+        xs_d = jax.device_put(xs, sharding)
+        ys_d = jax.device_put(ys, sharding)
+        state, losses = self._epoch_fn(state, xs_d, ys_d)
+        return state, np.asarray(losses)
+
+    # -- results ---------------------------------------------------------------
+    def center_model(self, state: ReplicaState) -> Model:
+        """The trained center — reference ``parameter_server.get_model()``."""
+        return Model(spec=self.spec, params=jax.tree.map(lambda x: jnp.asarray(x), state.center))
+
+    def local_models(self, state: ReplicaState) -> List[Model]:
+        """All per-replica models (EnsembleTrainer's return value)."""
+        local_np = jax.tree.map(np.asarray, state.local)
+        models = []
+        for i in range(self.num_replicas):
+            params = jax.tree.map(lambda a: jnp.asarray(a[i]), local_np)
+            models.append(Model(spec=self.spec, params=params))
+        return models
+
+    def averaged_model(self, state: ReplicaState) -> Model:
+        """Arithmetic mean of locals (AveragingTrainer, reference §2.2)."""
+        params = jax.tree.map(lambda a: jnp.mean(jnp.asarray(a), axis=0), state.local)
+        return Model(spec=self.spec, params=params)

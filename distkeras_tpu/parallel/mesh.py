@@ -1,0 +1,37 @@
+"""Device-mesh helpers.
+
+The reference's "cluster" was Spark executors + a TCP hub on the driver
+(SURVEY.md §2.14).  Here the cluster is a ``jax.sharding.Mesh``: the
+``replica`` axis carries data parallelism (one replica = one reference
+"worker"), and richer meshes (dp × tp × sp) serve the TPU-native models.
+Collectives ride ICI within a slice; ``jax.distributed`` extends the same
+mesh across hosts over DCN with no code change in the trainers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def create_mesh(num_devices: Optional[int] = None, axis_name: str = "replica") -> Mesh:
+    """1-D mesh over the first ``num_devices`` devices (data parallelism)."""
+    devices = jax.devices()
+    if num_devices is None:
+        num_devices = len(devices)
+    if num_devices > len(devices):
+        raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:num_devices]), (axis_name,))
+
+
+def create_nd_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
+    """N-D mesh, e.g. ``create_nd_mesh((2, 2, 2), ('dp', 'tp', 'sp'))``."""
+    n = int(np.prod(axis_sizes))
+    devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(f"mesh of {n} devices requested, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))

@@ -1,0 +1,251 @@
+"""Trainer API (reference parity: ``distkeras/trainers.py``).
+
+The reference exposed ``Trainer.train(dataframe) -> keras model`` with
+concrete classes ``SingleTrainer``, ``ADAG``, ``DOWNPOUR``, ``AEASGD``,
+``EAMSGD``, ``DynSGD``, ``AveragingTrainer``, ``EnsembleTrainer``
+(SURVEY.md §2.1–2.9).  Constructor surfaces are kept kwargs-compatible
+(``num_workers``, ``batch_size``, ``communication_window``, ``rho``,
+``learning_rate``, ``momentum``, ``num_epoch``, ``features_col``,
+``label_col``) so reference users can switch with minimal edits; Spark
+DataFrames become :class:`distkeras_tpu.data.Dataset`, "workers" become
+mesh replicas, and the parameter server becomes the window engine's
+collectives (see ``parallel/engine.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.parallel.algorithms import (
+    AdagAlgorithm,
+    Algorithm,
+    DownpourAlgorithm,
+    DynSGDAlgorithm,
+    ElasticAlgorithm,
+    NoCommitAlgorithm,
+)
+from distkeras_tpu.parallel.engine import WindowEngine, scan_epoch_fn
+from distkeras_tpu.parallel.mesh import create_mesh
+
+
+class Trainer:
+    """Base trainer: holds the model, loss, worker optimizer, data columns,
+    and wall-clock accounting (reference ``record_training_start/end``)."""
+
+    def __init__(self, model: Union[Model, ModelSpec], loss: Union[str, Callable] = "categorical_crossentropy",
+                 worker_optimizer: str = "sgd", learning_rate: float = 0.01,
+                 momentum: Optional[float] = None,
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+        if isinstance(model, ModelSpec):
+            model = Model.init(model, seed=seed)
+        self.model = model
+        self.loss = get_loss(loss)
+        self.optimizer = get_optimizer(worker_optimizer, learning_rate=learning_rate, momentum=momentum)
+        self.learning_rate = learning_rate
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = seed
+        self.history: List[float] = []  # per-window (or per-batch) mean loss
+        self._t_start: Optional[float] = None
+        self._t_end: Optional[float] = None
+
+    # reference API: record_training_start/record_training_end/get_training_time
+    def record_training_start(self) -> None:
+        self._t_start = time.time()
+        self._t_end = None
+
+    def record_training_end(self) -> None:
+        self._t_end = time.time()
+
+    def get_training_time(self) -> float:
+        if self._t_start is None:
+            return 0.0
+        end = self._t_end if self._t_end is not None else time.time()
+        return end - self._t_start
+
+    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """Single-device training — the reference's minimal path (SURVEY §3.2):
+    one coalesced partition, one worker, plain SGD.  Here: one chip, the
+    epoch compiled to a single ``lax.scan`` program."""
+
+    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+        self.record_training_start()
+        epoch_fn = scan_epoch_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
+        # epoch_fn donates its (params, opt_state) buffers; work on a copy so
+        # the caller's Model object stays valid
+        params = jax.tree.map(jnp.array, self.model.params)
+        opt_state = self.optimizer.init(params)
+        for epoch in range(self.num_epoch):
+            ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+            stacked = ds.stacked_epoch(self.batch_size, [self.features_col, self.label_col], window=1)
+            xs = stacked[self.features_col].squeeze(1)  # [num_batches, bs, ...]
+            ys = stacked[self.label_col].squeeze(1)
+            params, opt_state, losses = epoch_fn(params, opt_state, jnp.asarray(xs), jnp.asarray(ys))
+            self.history.extend(np.asarray(losses).tolist())
+        self.model = Model(spec=self.model.spec, params=params)
+        self.record_training_end()
+        return self.model
+
+
+class DistributedTrainer(Trainer):
+    """Common scaffolding for mesh-replica training (reference §2.4).
+
+    ``num_workers`` defaults to every visible device.  Subclasses provide
+    ``allocate_algorithm()`` — the analogue of the reference's
+    ``allocate_worker``/``allocate_parameter_server`` factory pair, now a
+    single collective update rule.
+    """
+
+    def __init__(self, model, num_workers: Optional[int] = None, communication_window: int = 5,
+                 mesh=None, **kwargs):
+        super().__init__(model, **kwargs)
+        self.communication_window = int(communication_window)
+        self.mesh = mesh if mesh is not None else create_mesh(num_workers)
+        self.num_workers = self.mesh.shape["replica"]
+        self._engine: Optional[WindowEngine] = None
+
+    def allocate_algorithm(self) -> Algorithm:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _divergent_seeds(self) -> Optional[Sequence[int]]:
+        return None
+
+    @property
+    def engine(self) -> WindowEngine:
+        if self._engine is None:
+            self._engine = WindowEngine(
+                spec=self.model.spec,
+                loss=self.loss,
+                optimizer=self.optimizer,
+                algorithm=self.allocate_algorithm(),
+                mesh=self.mesh,
+                window=self.communication_window,
+            )
+        return self._engine
+
+    def _run_epochs(self, dataset: Dataset, shuffle: bool) -> Any:
+        engine = self.engine
+        state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
+        global_batch = self.batch_size * self.num_workers
+        for epoch in range(self.num_epoch):
+            ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+            stacked = ds.stacked_epoch(global_batch, [self.features_col, self.label_col],
+                                       window=self.communication_window)
+            xs = stacked[self.features_col]
+            ys = stacked[self.label_col]
+            state, losses = engine.run_epoch(state, xs, ys)
+            self.history.extend(losses.tolist())
+        return state
+
+    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+        self.record_training_start()
+        state = self._run_epochs(dataset, shuffle)
+        self.model = self.engine.center_model(state)
+        self.record_training_end()
+        return self.model
+
+
+class ADAG(DistributedTrainer):
+    """Asynchronous Distributed Adaptive Gradients (reference §2.6):
+    windowed delta commits, normalized on the center."""
+
+    def allocate_algorithm(self) -> Algorithm:
+        return AdagAlgorithm()
+
+
+class DOWNPOUR(DistributedTrainer):
+    """Downpour SGD (reference §2.5): raw accumulated-delta commits."""
+
+    def allocate_algorithm(self) -> Algorithm:
+        return DownpourAlgorithm()
+
+
+class AEASGD(DistributedTrainer):
+    """Asynchronous elastic averaging SGD (reference §2.8)."""
+
+    def __init__(self, model, rho: float = 5.0, communication_window: int = 32, **kwargs):
+        super().__init__(model, communication_window=communication_window, **kwargs)
+        self.rho = float(rho)
+
+    def allocate_algorithm(self) -> Algorithm:
+        return ElasticAlgorithm(rho=self.rho, learning_rate=self.learning_rate)
+
+
+class EAMSGD(AEASGD):
+    """Elastic averaging with momentum on the local step (reference §2.9).
+    Same elastic commit as AEASGD; the momentum lives in the local optax
+    optimizer (Nesterov by default, per the EAMSGD paper)."""
+
+    def __init__(self, model, rho: float = 5.0, momentum: float = 0.9, **kwargs):
+        kwargs.setdefault("worker_optimizer", "nesterov")
+        super().__init__(model, rho=rho, momentum=momentum, **kwargs)
+
+
+class DynSGD(DistributedTrainer):
+    """Staleness-aware dynamic learning rate (reference §2.7):
+    commit r scaled by 1/(staleness_r + 1)."""
+
+    def allocate_algorithm(self) -> Algorithm:
+        return DynSGDAlgorithm()
+
+
+class AveragingTrainer(DistributedTrainer):
+    """Train N independent replicas, then average weights (reference §2.2)."""
+
+    def __init__(self, model, **kwargs):
+        kwargs.setdefault("communication_window", 1)
+        super().__init__(model, **kwargs)
+
+    def allocate_algorithm(self) -> Algorithm:
+        return NoCommitAlgorithm()
+
+    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+        self.record_training_start()
+        state = self._run_epochs(dataset, shuffle)
+        self.model = self.engine.averaged_model(state)
+        self.record_training_end()
+        return self.model
+
+
+class EnsembleTrainer(DistributedTrainer):
+    """Train N independent models and return all of them (reference §2.3).
+
+    ``decorrelate=True`` re-initializes each member from its own seed
+    (reference used ``utils.uniform_weights`` for this).
+    """
+
+    def __init__(self, model, decorrelate: bool = True, **kwargs):
+        kwargs.setdefault("communication_window", 1)
+        super().__init__(model, **kwargs)
+        self.decorrelate = decorrelate
+
+    def allocate_algorithm(self) -> Algorithm:
+        return NoCommitAlgorithm()
+
+    def _divergent_seeds(self) -> Optional[Sequence[int]]:
+        if not self.decorrelate:
+            return None
+        return [self.seed + 1000 + i for i in range(self.num_workers)]
+
+    def train(self, dataset: Dataset, shuffle: bool = True) -> List[Model]:  # type: ignore[override]
+        self.record_training_start()
+        state = self._run_epochs(dataset, shuffle)
+        models = self.engine.local_models(state)
+        self.record_training_end()
+        return models

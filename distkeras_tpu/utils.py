@@ -1,0 +1,116 @@
+"""Utility helpers (reference parity: ``distkeras/utils.py``).
+
+The reference's utility layer provides Keras model serialization
+(``serialize_keras_model`` / ``deserialize_keras_model`` — architecture JSON
+plus a weight list), weight re-initialization (``uniform_weights``), dataset
+shuffling, and small DataFrame helpers.  Here the same surface is provided
+for Flax/JAX: a model is an architecture record (registry name + config)
+plus a parameter pytree, and all helpers are pure functions over numpy/JAX
+arrays.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_weights(params: Any) -> Tuple[List[np.ndarray], Any]:
+    """Flatten a parameter pytree into an ordered weight list + treedef.
+
+    Mirrors the reference's representation of a model's weights as the flat
+    list returned by Keras ``model.get_weights()``.
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    return [np.asarray(leaf) for leaf in leaves], treedef
+
+
+def unflatten_weights(treedef: Any, weights: List[np.ndarray]) -> Any:
+    return jax.tree.unflatten(treedef, [jnp.asarray(w) for w in weights])
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered custom dtypes (bfloat16, fp8, ...)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_model(architecture: Dict[str, Any], params: Any) -> bytes:
+    """Serialize (architecture, weights) to bytes — npz + JSON, **no pickle**.
+
+    Reference parity: ``utils.serialize_keras_model`` produced a dict of
+    ``{'model': architecture_json, 'weights': weight_list}``; we keep the
+    same two-part structure so a model can travel between processes (the
+    reference shipped it inside Spark task closures; here it crosses host
+    boundaries for multi-host launch or checkpointing).  The reference used
+    pickle, which executes arbitrary code at load time; here weights are
+    raw bytes with a JSON manifest of (dtype, shape), so loading untrusted
+    checkpoints is safe.  Non-numpy dtypes (bfloat16 etc.) are stored as
+    byte views and restored via their recorded dtype name.
+    """
+    weights, _ = flatten_weights(params)
+    manifest = {
+        "architecture": architecture,
+        "weights": [{"dtype": w.dtype.name, "shape": list(w.shape)} for w in weights],
+    }
+    buf = io.BytesIO()
+    arrays = {f"w{i}": np.ascontiguousarray(w).view(np.uint8).reshape(-1) for i, w in enumerate(weights)}
+    np.savez(buf, __manifest__=np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8), **arrays)
+    return buf.getvalue()
+
+
+def deserialize_model(blob: bytes) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    """Inverse of :func:`serialize_model` (``allow_pickle=False`` throughout).
+
+    Returns the architecture dict and the flat weight list; use the model
+    registry (``models.base.build_model``) to reconstruct the pytree
+    structure and :func:`unflatten_weights` to restore parameters.
+    """
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        weights = []
+        for i, meta in enumerate(manifest["weights"]):
+            raw = z[f"w{i}"]
+            dtype = _dtype_from_name(meta["dtype"])
+            weights.append(np.frombuffer(raw.tobytes(), dtype=dtype).reshape(meta["shape"]))
+    return manifest["architecture"], weights
+
+
+def uniform_weights(params: Any, seed: int = 0, low: float = -0.05, high: float = 0.05) -> Any:
+    """Re-initialize every weight tensor uniformly in ``[low, high]``.
+
+    Reference parity: ``utils.uniform_weights(model)`` which re-drew each
+    Keras weight array from a uniform distribution (used to decorrelate
+    ensemble members).
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    new_leaves = [
+        jax.random.uniform(k, shape=jnp.shape(leaf), dtype=jnp.result_type(leaf), minval=low, maxval=high)
+        for k, leaf in zip(keys, leaves)
+    ]
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+def shuffle_arrays(arrays: Dict[str, np.ndarray], seed: int = 0) -> Dict[str, np.ndarray]:
+    """Shuffle all columns with one shared permutation.
+
+    Reference parity: ``utils.shuffle(dataset)`` (row shuffle of the
+    DataFrame before repartitioning across workers).
+    """
+    sizes = {len(v) for v in arrays.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"columns have mismatched lengths: { {k: len(v) for k, v in arrays.items()} }")
+    n = sizes.pop()
+    perm = np.random.default_rng(seed).permutation(n)
+    return {k: v[perm] for k, v in arrays.items()}
+
+

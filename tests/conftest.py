@@ -1,0 +1,47 @@
+"""Test harness: simulate an 8-chip slice on CPU.
+
+This is the multi-node test strategy the reference never had (SURVEY §4):
+``--xla_force_host_platform_device_count=8`` gives 8 virtual XLA devices,
+so every mesh/collective path runs in CI without TPU hardware.  Must be
+set before jax initializes — hence here, at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin's sitecustomize forces jax_platforms="axon,cpu" at
+# interpreter start, which overrides the env var — override it back before
+# any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def toy_classification():
+    """Linearly-separable 2-class blobs: learnable in a few SGD steps."""
+    rng = np.random.default_rng(0)
+    n = 1024
+    half = n // 2
+    x0 = rng.normal(loc=-2.0, scale=1.0, size=(half, 8)).astype(np.float32)
+    x1 = rng.normal(loc=+2.0, scale=1.0, size=(half, 8)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(half, np.int32), np.ones(half, np.int32)])
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="session")
+def toy_dataset(toy_classification):
+    from distkeras_tpu.data.dataset import Dataset
+
+    x, y = toy_classification
+    onehot = np.eye(2, dtype=np.float32)[y]
+    return Dataset({"features": x, "label": onehot, "label_index": y})

@@ -1,0 +1,99 @@
+"""Algorithm-semantics tests (SURVEY §4.4): each commit rule verified
+against its closed-form single-window expectation on an 8-replica mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.parallel.algorithms import (
+    AdagAlgorithm,
+    DownpourAlgorithm,
+    DynSGDAlgorithm,
+    ElasticAlgorithm,
+    NoCommitAlgorithm,
+)
+from distkeras_tpu.parallel.mesh import create_mesh
+
+R = 8
+
+
+def run_commit(algo, center, local):
+    """Run one window_commit under shard_map; center [D], local [R, D]."""
+    mesh = create_mesh(R)
+
+    def fn(center, local):
+        l = local[0]
+        c2, l2, _ = algo.window_commit(center, l, {}, "replica")
+        return c2, l2[None]
+
+    out = jax.shard_map(fn, mesh=mesh, in_specs=(P(), P("replica")), out_specs=(P(), P("replica")))(
+        jnp.asarray(center), jnp.asarray(local)
+    )
+    return np.asarray(out[0]), np.asarray(out[1])
+
+
+@pytest.fixture
+def weights():
+    rng = np.random.default_rng(42)
+    center = rng.normal(size=(16,)).astype(np.float32)
+    local = rng.normal(size=(R, 16)).astype(np.float32)
+    return center, local
+
+
+def test_adag_commit_is_mean_delta(weights):
+    center, local = weights
+    new_center, new_local = run_commit(AdagAlgorithm(), center, local)
+    expected = center + (local - center).mean(axis=0)
+    np.testing.assert_allclose(new_center, expected, rtol=1e-5)
+    # post-commit pull: every local equals the new center
+    for r in range(R):
+        np.testing.assert_allclose(new_local[r], expected, rtol=1e-5)
+
+
+def test_downpour_commit_is_sum_delta(weights):
+    center, local = weights
+    new_center, new_local = run_commit(DownpourAlgorithm(), center, local)
+    expected = center + (local - center).sum(axis=0)
+    np.testing.assert_allclose(new_center, expected, rtol=1e-4)
+    np.testing.assert_allclose(new_local[0], expected, rtol=1e-4)
+
+
+def test_elastic_commit_spring_forces(weights):
+    center, local = weights
+    rho, lr = 5.0, 0.01
+    alpha = rho * lr
+    new_center, new_local = run_commit(ElasticAlgorithm(rho=rho, learning_rate=lr), center, local)
+    ediff = alpha * (local - center)
+    np.testing.assert_allclose(new_center, center + ediff.sum(axis=0), rtol=1e-4)
+    # locals pulled toward center but NOT reset: divergence preserved
+    np.testing.assert_allclose(new_local, local - ediff, rtol=1e-4)
+    assert not np.allclose(new_local[0], new_local[1])
+
+
+def test_elastic_fixed_point(weights):
+    """If all locals equal the center, elastic averaging is a no-op."""
+    center, _ = weights
+    local = np.stack([center] * R)
+    new_center, new_local = run_commit(ElasticAlgorithm(rho=5.0, learning_rate=0.01), center, local)
+    np.testing.assert_allclose(new_center, center, rtol=1e-5)
+    np.testing.assert_allclose(new_local, local, rtol=1e-5)
+
+
+def test_dynsgd_staleness_scaling(weights):
+    center, local = weights
+    new_center, new_local = run_commit(DynSGDAlgorithm(), center, local)
+    # deterministic serialization: replica r has staleness r -> scale 1/(r+1)
+    expected = center.copy()
+    for r in range(R):
+        expected = expected + (local[r] - center) / (r + 1)
+    np.testing.assert_allclose(new_center, expected, rtol=1e-4)
+    np.testing.assert_allclose(new_local[3], expected, rtol=1e-4)
+
+
+def test_nocommit_is_identity(weights):
+    center, local = weights
+    new_center, new_local = run_commit(NoCommitAlgorithm(), center, local)
+    np.testing.assert_allclose(new_center, center)
+    np.testing.assert_allclose(new_local, local)

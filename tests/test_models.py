@@ -1,0 +1,45 @@
+"""Model-zoo smoke tests: build, forward-shape, registry round-trip."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.models.cnn import mnist_cnn_spec
+from distkeras_tpu.models.mlp import mnist_mlp_spec
+from distkeras_tpu.models.resnet import resnet20_spec
+from distkeras_tpu.models.transformer import small_lm_spec
+
+
+@pytest.mark.parametrize("spec_fn,batch_shape,out_shape", [
+    (mnist_mlp_spec, (2, 784), (2, 10)),
+    (mnist_cnn_spec, (2, 28, 28, 1), (2, 10)),
+])
+def test_forward_shapes(spec_fn, batch_shape, out_shape):
+    model = Model.init(spec_fn(), seed=0)
+    x = np.zeros(batch_shape, dtype=np.float32)
+    assert model.apply(x).shape == out_shape
+
+
+def test_resnet20_forward():
+    model = Model.init(resnet20_spec(num_outputs=100), seed=0)
+    x = np.zeros((2, 32, 32, 3), dtype=np.float32)
+    assert model.apply(x).shape == (2, 100)
+
+
+def test_transformer_forward():
+    spec = small_lm_spec(vocab_size=64, model_dim=32, num_heads=2, num_layers=2, max_seq_len=16)
+    model = Model.init(spec, seed=0)
+    tokens = np.zeros((2, 16), dtype=np.int32)
+    logits = model.apply(tokens)
+    assert logits.shape == (2, 16, 64)
+
+
+def test_unknown_architecture_raises():
+    with pytest.raises(ValueError, match="unknown architecture"):
+        ModelSpec(name="nope", config={}, input_shape=(4,)).build()
+
+
+def test_spec_dict_roundtrip():
+    spec = mnist_cnn_spec()
+    assert ModelSpec.from_dict(spec.to_dict()) == spec

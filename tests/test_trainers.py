@@ -1,0 +1,105 @@
+"""Trainer integration tests on the simulated 8-chip slice (SURVEY §4.2/4.3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.predictors import ModelPredictor
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+)
+
+
+def tiny_mlp_spec():
+    return ModelSpec(name="mlp", config={"hidden_sizes": (32,), "num_outputs": 2}, input_shape=(8,))
+
+
+def accuracy_of(model, dataset):
+    ds = ModelPredictor(model, features_col="features").predict(dataset)
+    return AccuracyEvaluator(prediction_col="prediction", label_col="label_index").evaluate(ds)
+
+
+def test_single_trainer_learns(toy_dataset):
+    trainer = SingleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                            worker_optimizer="sgd", learning_rate=0.1,
+                            batch_size=64, num_epoch=5)
+    model = trainer.train(toy_dataset)
+    assert trainer.history[-1] < trainer.history[0]
+    assert accuracy_of(model, toy_dataset) > 0.95
+    assert trainer.get_training_time() > 0
+
+
+@pytest.mark.parametrize("trainer_cls,kwargs", [
+    (ADAG, {"communication_window": 2}),
+    (DOWNPOUR, {"communication_window": 4, "learning_rate": 0.01}),
+    (AEASGD, {"communication_window": 4, "rho": 1.0}),
+    (EAMSGD, {"communication_window": 4, "rho": 1.0, "momentum": 0.9}),
+    (DynSGD, {"communication_window": 2}),
+])
+def test_distributed_trainers_learn(toy_dataset, trainer_cls, kwargs):
+    kwargs = dict(kwargs)
+    kwargs.setdefault("learning_rate", 0.05)
+    trainer = trainer_cls(tiny_mlp_spec(), loss="categorical_crossentropy",
+                          worker_optimizer=kwargs.pop("worker_optimizer", "sgd"),
+                          num_workers=8, batch_size=8, num_epoch=4, **kwargs)
+    model = trainer.train(toy_dataset)
+    assert accuracy_of(model, toy_dataset) > 0.9, f"{trainer_cls.__name__} failed to learn"
+
+
+def test_adag_window1_matches_large_batch_sgd(toy_dataset):
+    """ADAG with window=1 is exactly large-batch SGD: center' =
+    center − lr · mean_r grad_r — must match a single-device run on the
+    same global batches (the sync-equivalence anchor for the collectives)."""
+    lr, bs, workers = 0.1, 16, 8
+    single = SingleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                           worker_optimizer="sgd", learning_rate=lr,
+                           batch_size=bs * workers, num_epoch=1, seed=0)
+    m_single = single.train(toy_dataset, shuffle=False)
+
+    adag = ADAG(tiny_mlp_spec(), loss="categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=lr, num_workers=workers,
+                batch_size=bs, communication_window=1, num_epoch=1, seed=0)
+    m_adag = adag.train(toy_dataset, shuffle=False)
+
+    for a, b in zip(jax.tree.leaves(m_single.params), jax.tree.leaves(m_adag.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_averaging_trainer(toy_dataset):
+    trainer = AveragingTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                               learning_rate=0.1, num_workers=8, batch_size=8, num_epoch=3)
+    model = trainer.train(toy_dataset)
+    assert accuracy_of(model, toy_dataset) > 0.9
+
+
+def test_ensemble_trainer_returns_n_distinct_models(toy_dataset):
+    trainer = EnsembleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                              learning_rate=0.1, num_workers=8, batch_size=8, num_epoch=2)
+    models = trainer.train(toy_dataset)
+    assert len(models) == 8
+    p0 = jax.tree.leaves(models[0].params)[0]
+    p1 = jax.tree.leaves(models[1].params)[0]
+    assert not np.allclose(np.asarray(p0), np.asarray(p1))
+    assert accuracy_of(models[0], toy_dataset) > 0.85
+
+
+def test_determinism_same_seed_same_result(toy_dataset):
+    """Sync path determinism (SURVEY §5 race-detection replacement)."""
+    def run():
+        t = ADAG(tiny_mlp_spec(), loss="categorical_crossentropy", learning_rate=0.05,
+                 num_workers=8, batch_size=8, communication_window=2, num_epoch=1, seed=123)
+        return t.train(toy_dataset)
+
+    m1, m2 = run(), run()
+    for a, b in zip(jax.tree.leaves(m1.params), jax.tree.leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

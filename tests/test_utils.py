@@ -1,0 +1,55 @@
+"""Unit tests: serialization round-trips and utility math (SURVEY §4.1)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import utils
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.models.mlp import mnist_mlp_spec
+
+
+def small_mlp():
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2}, input_shape=(8,))
+
+
+def test_model_serialize_roundtrip():
+    model = Model.init(small_mlp(), seed=3)
+    blob = model.serialize()
+    restored = Model.deserialize(blob)
+    assert restored.spec == model.spec
+    orig, _ = utils.flatten_weights(model.params)
+    back, _ = utils.flatten_weights(restored.params)
+    assert len(orig) == len(back)
+    for a, b in zip(orig, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_serialized_model_predicts_identically():
+    model = Model.init(small_mlp(), seed=1)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    np.testing.assert_allclose(model.apply(x), Model.deserialize(model.serialize()).apply(x), rtol=1e-6)
+
+
+def test_uniform_weights_changes_and_bounds():
+    model = Model.init(small_mlp(), seed=0)
+    new_params = utils.uniform_weights(model.params, seed=7, low=-0.05, high=0.05)
+    leaves, _ = utils.flatten_weights(new_params)
+    for leaf in leaves:
+        assert leaf.min() >= -0.05 and leaf.max() <= 0.05
+    old_leaves, _ = utils.flatten_weights(model.params)
+    assert any(not np.array_equal(a, b) for a, b in zip(old_leaves, leaves))
+
+
+def test_shuffle_arrays_consistent_permutation():
+    x = np.arange(10)
+    y = np.arange(10) * 2
+    out = utils.shuffle_arrays({"x": x, "y": y}, seed=1)
+    np.testing.assert_array_equal(out["y"], out["x"] * 2)
+    assert not np.array_equal(out["x"], x)
+
+
+def test_shuffle_arrays_rejects_mismatched():
+    with pytest.raises(ValueError):
+        utils.shuffle_arrays({"x": np.arange(3), "y": np.arange(4)})
