@@ -1,0 +1,84 @@
+"""Headline benchmark: MNIST-CNN training samples/sec/chip (BASELINE.md §1).
+
+Prints exactly one JSON line:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Runs on whatever accelerator is visible (the driver provides one real TPU
+chip).  Data content doesn't affect throughput, so MNIST-shaped synthetic
+tensors stand in for the real dataset in offline environments.
+
+``vs_baseline``: the reference publishes no benchmark numbers
+(BASELINE.md — "none recoverable"; upstream dist-keras ships no metric
+table), so the ratio is against the recorded best of THIS repo
+(bench_baseline.json, committed once established).  First run: 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import mnist_cnn_spec
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.parallel.engine import scan_epoch_fn
+
+    batch_size = 256
+    num_batches = 200
+    spec = mnist_cnn_spec()
+    model = Model.init(spec, seed=0)
+    optimizer = optax.sgd(0.01, momentum=0.9)
+    epoch_fn = scan_epoch_fn(spec.apply_fn(), get_loss("categorical_crossentropy"), optimizer)
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(num_batches, batch_size, 28, 28, 1)).astype(np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=(num_batches, batch_size))]
+    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+
+    params = jax.tree.map(jnp.array, model.params)
+    opt_state = optimizer.init(params)
+
+    # warmup (compile + one full pass); host readback is the only reliable
+    # completion barrier on relayed/remote platforms, where
+    # block_until_ready can return before execution finishes
+    params, opt_state, losses = epoch_fn(params, opt_state, xs_d, ys_d)
+    np.asarray(losses)
+
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        params, opt_state, losses = epoch_fn(params, opt_state, xs_d, ys_d)
+        np.asarray(losses)
+    dt = time.perf_counter() - t0
+
+    samples = reps * num_batches * batch_size
+    sps = samples / dt
+    n_chips = jax.device_count()
+    sps_per_chip = sps / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("value")
+        if base:
+            vs = sps_per_chip / base
+
+    print(json.dumps({
+        "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,15 +82,35 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: st
     m0 = jnp.full((b, h, l_local), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, l_local), dtype=jnp.float32)
     acc0 = jnp.zeros((b, l_local, h, d), dtype=jnp.float32)
-    # accumulators become device-varying on the first scan step; mark them so
-    m0, l0, acc0 = (lax.pcast(x, (axis_name,), to="varying") for x in (m0, l0, acc0))
+    # accumulators become device-varying on the first scan step; mark them
+    # with q's full varying set (covers extra mesh axes like dp)
+    vma = tuple(jax.typeof(q).vma) or (axis_name,)
+    m0, l0, acc0 = (lax.pcast(x, vma, to="varying") for x in (m0, l0, acc0))
     (m, l_sum, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(sp))
     denom = jnp.maximum(l_sum, 1e-20).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
 
 
 def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None):
-    """Dispatch: ring attention when a sequence mesh axis is given, else dense."""
+    """Dispatch: ring attention when a sequence mesh axis is given, else dense.
+
+    A sequence-parallel model traced outside ``shard_map`` (e.g. parameter
+    init, or single-device eval of the same spec) has no bound axis; fall
+    back to dense attention — parameters and semantics are identical, only
+    the schedule differs.  The fallback applies ONLY when no mesh axes are
+    bound at all: inside a shard_map whose axes don't include ``axis_name``,
+    falling back would silently attend within each local shard, so that is
+    an error instead.
+    """
+    if axis_name is not None and not jax.typeof(q).vma:
+        axis_name = None  # traced outside any shard_map: dense is exact
     if axis_name is None:
         return dense_attention(q, k, v, causal=causal)
+    try:
+        lax.axis_size(axis_name)
+    except NameError:
+        raise ValueError(
+            f"sequence axis {axis_name!r} is not bound by the enclosing shard_map "
+            f"(bound varying axes: {sorted(jax.typeof(q).vma)}); the model's seq_axis "
+            f"must match the mesh axis the sequence is sharded over") from None
     return ring_attention(q, k, v, axis_name=axis_name, causal=causal)

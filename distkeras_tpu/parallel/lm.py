@@ -1,0 +1,103 @@
+"""Sequence-parallel LM training: dp × sp shard_map step with ring attention.
+
+No reference counterpart (the reference predates transformers; SURVEY §5
+"long-context: absent") — this is the TPU-native long-context path: batch
+sharded over the ``dp`` mesh axis, sequence sharded over ``sp`` with ring
+attention streaming KV blocks over ICI (``ops/attention.py``), gradients
+pmean'd over both axes, parameters replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.base import ModelSpec
+
+
+def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                       mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp") -> Callable:
+    """Build a jitted (params, opt_state, tokens, targets) -> (params,
+    opt_state, loss) step. ``spec`` must be a transformer_lm whose config
+    sets ``seq_axis=sp_axis``; tokens/targets are [B, L] with B sharded
+    over dp and L sharded over sp (targets pre-shifted on host).
+    """
+    if spec.config.get("seq_axis") != sp_axis:
+        raise ValueError(
+            f"spec.config['seq_axis'] = {spec.config.get('seq_axis')!r} must equal "
+            f"sp_axis = {sp_axis!r} or ring attention would not ride this mesh axis")
+    module = spec.build()
+
+    def local_loss(params, tokens, targets, offset):
+        logits = module.apply({"params": params}, tokens, pos_offset=offset)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets.astype(jnp.int32))
+        # mask the GLOBAL final position: its target is shift_targets'
+        # padding, not a real next token.  Global position = offset + local
+        # index; only the last sp shard holds the padded column.
+        l_local = tokens.shape[1]
+        global_len = l_local * lax.axis_size(sp_axis)
+        pos = offset + jnp.arange(l_local)
+        weights = (pos < global_len - 1).astype(jnp.float32)[None, :]
+        wsum = jnp.sum(ce * weights)
+        wcount = jnp.sum(weights) * tokens.shape[0]
+        return wsum, wcount
+
+    def shard_fn(params, opt_state, tokens, targets):
+        offset = lax.axis_index(sp_axis) * tokens.shape[1]
+
+        # Differentiate the GLOBAL (pmean'd) loss and use the result as-is.
+        # ``params`` enter the shard as mesh-invariant (P()); their use in
+        # varying computation is an implicit broadcast whose transpose is a
+        # psum, so ``jax.grad`` already returns the cross-shard-summed
+        # gradient of whatever scalar it was given.  Hand it the *global*
+        # loss (psum-normalized masked CE) and the result is exactly dG/dparams —
+        # adding a manual pmean/psum afterwards double-counts by the mesh
+        # size.  This also routes sequence-crossing paths (ring attention
+        # streams KV over sp) correctly via the collective adjoints.
+        def global_loss(p):
+            wsum, wcount = local_loss(p, tokens, targets, offset)
+            # wcount depends only on the sp position -> varying over sp but
+            # not dp; psum requires a uniform varying set, so widen it
+            both = (dp_axis, sp_axis)
+            missing = tuple(a for a in both if a not in jax.typeof(wcount).vma)
+            if missing:
+                wcount = lax.pcast(wcount, missing, to="varying")
+            return lax.psum(wsum, both) / lax.psum(wcount, both)
+
+        loss, grads = jax.value_and_grad(global_loss)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    data_spec = P(dp_axis, sp_axis)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def lm_data_shardings(mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"):
+    return NamedSharding(mesh, P(dp_axis, sp_axis))
+
+
+def shift_targets(tokens) -> Any:
+    """Host-side next-token targets: targets[t] = tokens[t+1], last = pad(0).
+
+    Done on the host because the shift crosses sp shard boundaries; the
+    cost is one roll over an int array per batch.  The padded final position
+    is excluded from the training loss by ``make_lm_train_step``'s mask.
+    """
+    import numpy as np
+
+    targets = np.roll(np.asarray(tokens), -1, axis=-1)
+    targets[..., -1] = 0
+    return targets
