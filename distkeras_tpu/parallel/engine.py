@@ -151,6 +151,11 @@ class WindowEngine:
         extra = jax.tree.map(lambda x: np.stack([np.asarray(x)] * r), extra0)
         state = ReplicaState(center=center, local=local, opt_state=opt_state,
                              extra=extra, step=np.zeros((), np.int32))
+        return self.shard_state(state)
+
+    def shard_state(self, state: ReplicaState) -> ReplicaState:
+        """Place a (host or restored-from-checkpoint) state onto the mesh
+        with this engine's shardings."""
         return jax.device_put(state, self._state_shardings())
 
     # -- compiled epoch --------------------------------------------------------

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu.checkpoint import Checkpointer
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.base import Model, ModelSpec
 from distkeras_tpu.ops.losses import get_loss
@@ -75,29 +76,50 @@ class Trainer:
         end = self._t_end if self._t_end is not None else time.time()
         return end - self._t_start
 
-    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:  # pragma: no cover - interface
+    def train(self, dataset: Dataset, shuffle: bool = True,
+              checkpointer: Optional[Checkpointer] = None) -> Model:  # pragma: no cover - interface
         raise NotImplementedError
 
 
 class SingleTrainer(Trainer):
     """Single-device training — the reference's minimal path (SURVEY §3.2):
     one coalesced partition, one worker, plain SGD.  Here: one chip, the
-    epoch compiled to a single ``lax.scan`` program."""
+    epoch compiled to a single ``lax.scan`` program.
 
-    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+    ``checkpointer`` (no reference counterpart — SURVEY §5 "Checkpoint:
+    none in-library") persists (params, opt_state) after every epoch and
+    resumes from the latest checkpoint if one exists.
+    """
+
+    def train(self, dataset: Dataset, shuffle: bool = True,
+              checkpointer: Optional[Checkpointer] = None) -> Model:
         self.record_training_start()
         epoch_fn = scan_epoch_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
         # epoch_fn donates its (params, opt_state) buffers; work on a copy so
         # the caller's Model object stays valid
         params = jax.tree.map(jnp.array, self.model.params)
         opt_state = self.optimizer.init(params)
-        for epoch in range(self.num_epoch):
+        start_epoch = 0
+        if checkpointer is not None:
+            # resolve the step once: restore() and metadata() must read the
+            # SAME checkpoint even if a concurrent writer lands a new one
+            ckpt_step = checkpointer.latest_step()
+            if ckpt_step is not None:
+                restored = checkpointer.restore({"params": params, "opt_state": opt_state},
+                                                step=ckpt_step)
+                params = jax.tree.map(jnp.asarray, restored["params"])
+                opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
+                start_epoch = int(checkpointer.metadata(step=ckpt_step)["metadata"]["epochs_done"])
+        for epoch in range(start_epoch, self.num_epoch):
             ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
             stacked = ds.stacked_epoch(self.batch_size, [self.features_col, self.label_col], window=1)
             xs = stacked[self.features_col].squeeze(1)  # [num_batches, bs, ...]
             ys = stacked[self.label_col].squeeze(1)
             params, opt_state, losses = epoch_fn(params, opt_state, jnp.asarray(xs), jnp.asarray(ys))
             self.history.extend(np.asarray(losses).tolist())
+            if checkpointer is not None:
+                checkpointer.save(epoch + 1, {"params": params, "opt_state": opt_state},
+                                  metadata={"epochs_done": epoch + 1})
         self.model = Model(spec=self.model.spec, params=params)
         self.record_training_end()
         return self.model
@@ -139,11 +161,19 @@ class DistributedTrainer(Trainer):
             )
         return self._engine
 
-    def _run_epochs(self, dataset: Dataset, shuffle: bool) -> Any:
+    def _run_epochs(self, dataset: Dataset, shuffle: bool,
+                    checkpointer: Optional[Checkpointer] = None) -> Any:
         engine = self.engine
         state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
+        start_epoch = 0
+        if checkpointer is not None:
+            ckpt_step = checkpointer.latest_step()
+            if ckpt_step is not None:
+                restored = checkpointer.restore({"state": state}, step=ckpt_step)["state"]
+                state = engine.shard_state(restored)
+                start_epoch = int(checkpointer.metadata(step=ckpt_step)["metadata"]["epochs_done"])
         global_batch = self.batch_size * self.num_workers
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
             stacked = ds.stacked_epoch(global_batch, [self.features_col, self.label_col],
                                        window=self.communication_window)
@@ -151,11 +181,15 @@ class DistributedTrainer(Trainer):
             ys = stacked[self.label_col]
             state, losses = engine.run_epoch(state, xs, ys)
             self.history.extend(losses.tolist())
+            if checkpointer is not None:
+                checkpointer.save(epoch + 1, {"state": state},
+                                  metadata={"epochs_done": epoch + 1})
         return state
 
-    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+    def train(self, dataset: Dataset, shuffle: bool = True,
+              checkpointer: Optional[Checkpointer] = None) -> Model:
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle)
+        state = self._run_epochs(dataset, shuffle, checkpointer)
         self.model = self.engine.center_model(state)
         self.record_training_end()
         return self.model
@@ -215,9 +249,10 @@ class AveragingTrainer(DistributedTrainer):
     def allocate_algorithm(self) -> Algorithm:
         return NoCommitAlgorithm()
 
-    def train(self, dataset: Dataset, shuffle: bool = True) -> Model:
+    def train(self, dataset: Dataset, shuffle: bool = True,
+              checkpointer: Optional[Checkpointer] = None) -> Model:
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle)
+        state = self._run_epochs(dataset, shuffle, checkpointer)
         self.model = self.engine.averaged_model(state)
         self.record_training_end()
         return self.model
@@ -243,9 +278,10 @@ class EnsembleTrainer(DistributedTrainer):
             return None
         return [self.seed + 1000 + i for i in range(self.num_workers)]
 
-    def train(self, dataset: Dataset, shuffle: bool = True) -> List[Model]:  # type: ignore[override]
+    def train(self, dataset: Dataset, shuffle: bool = True,
+              checkpointer: Optional[Checkpointer] = None) -> List[Model]:  # type: ignore[override]
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle)
+        state = self._run_epochs(dataset, shuffle, checkpointer)
         models = self.engine.local_models(state)
         self.record_training_end()
         return models

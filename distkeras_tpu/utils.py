@@ -43,6 +43,18 @@ def _dtype_from_name(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def encode_array(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 byte view of an array — the npz-safe leaf encoding shared
+    by model serialization and checkpointing (handles bfloat16 etc., which
+    npz cannot store natively)."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def decode_array(raw: np.ndarray, dtype_name: str, shape) -> np.ndarray:
+    """Inverse of :func:`encode_array` given the recorded dtype name/shape."""
+    return np.frombuffer(raw.tobytes(), dtype=_dtype_from_name(dtype_name)).reshape(shape)
+
+
 def serialize_model(architecture: Dict[str, Any], params: Any) -> bytes:
     """Serialize (architecture, weights) to bytes — npz + JSON, **no pickle**.
 
@@ -62,7 +74,7 @@ def serialize_model(architecture: Dict[str, Any], params: Any) -> bytes:
         "weights": [{"dtype": w.dtype.name, "shape": list(w.shape)} for w in weights],
     }
     buf = io.BytesIO()
-    arrays = {f"w{i}": np.ascontiguousarray(w).view(np.uint8).reshape(-1) for i, w in enumerate(weights)}
+    arrays = {f"w{i}": encode_array(w) for i, w in enumerate(weights)}
     np.savez(buf, __manifest__=np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8), **arrays)
     return buf.getvalue()
 
@@ -76,11 +88,8 @@ def deserialize_model(blob: bytes) -> Tuple[Dict[str, Any], List[np.ndarray]]:
     """
     with np.load(io.BytesIO(blob), allow_pickle=False) as z:
         manifest = json.loads(bytes(z["__manifest__"]).decode())
-        weights = []
-        for i, meta in enumerate(manifest["weights"]):
-            raw = z[f"w{i}"]
-            dtype = _dtype_from_name(meta["dtype"])
-            weights.append(np.frombuffer(raw.tobytes(), dtype=dtype).reshape(meta["shape"]))
+        weights = [decode_array(z[f"w{i}"], meta["dtype"], meta["shape"])
+                   for i, meta in enumerate(manifest["weights"])]
     return manifest["architecture"], weights
 
 

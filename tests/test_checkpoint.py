@@ -1,0 +1,103 @@
+"""Checkpoint/resume tests — the aux subsystem the reference never had
+(SURVEY.md §5): atomic no-pickle persistence of full training state, and
+bit-exact resume of interrupted training."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.checkpoint import Checkpointer, restore_tree, save_tree
+
+
+def tree_equal(a, b):
+    import jax
+
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for x, y in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tree = {
+        "dense": {"kernel": np.arange(12, dtype=np.float32).reshape(3, 4),
+                  "bias": np.zeros(4, np.float32)},
+        "step": np.int32(7),
+        "bf16": jnp.ones((8,), jnp.bfloat16) * 1.5,
+    }
+    p = str(tmp_path / "tree")
+    save_tree(p, tree)
+    template = {
+        "dense": {"kernel": np.zeros((3, 4), np.float32), "bias": np.zeros(4, np.float32)},
+        "step": np.int32(0),
+        "bf16": jnp.zeros((8,), jnp.bfloat16),
+    }
+    restored = restore_tree(p, template)
+    tree_equal(tree, restored)
+    assert restored["bf16"].dtype == jnp.bfloat16
+
+
+def test_restore_structure_mismatch_raises(tmp_path):
+    p = str(tmp_path / "tree")
+    save_tree(p, {"a": np.zeros(3)})
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_tree(p, {"b": np.zeros(3)})
+    with pytest.raises(ValueError, match="shape"):
+        restore_tree(p, {"a": np.zeros(4)})
+
+
+def test_checkpointer_retention_and_latest(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    for step in [1, 2, 3, 4]:
+        ckpt.save(step, {"t": {"x": np.full(2, step, np.float32)}}, metadata={"epochs_done": step})
+    assert ckpt.all_steps() == [3, 4]
+    assert ckpt.latest_step() == 4
+    out = ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
+    np.testing.assert_array_equal(out["t"]["x"], np.full(2, 4.0))
+    assert ckpt.metadata()["metadata"]["epochs_done"] == 4
+    # no tmp dirs left behind
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp")]
+
+
+def test_single_trainer_resume_bit_exact(tmp_path, toy_dataset):
+    """1 epoch + resume for the 2nd == 2 epochs straight, to the bit."""
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.trainers import SingleTrainer
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2}, input_shape=(8,))
+
+    def make(num_epoch):
+        return SingleTrainer(Model.init(spec, seed=0), loss="categorical_crossentropy",
+                             batch_size=64, num_epoch=num_epoch, seed=3)
+
+    t_straight = make(2)
+    straight = t_straight.train(toy_dataset)
+
+    ckpt_dir = str(tmp_path / "ck")
+    make(1).train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
+    t2 = make(2)
+    resumed = t2.train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
+    tree_equal(straight.params, resumed.params)
+    # resume skipped epoch 0: history holds exactly the 2nd epoch's batches
+    assert len(t2.history) * 2 == len(t_straight.history)
+
+
+def test_distributed_trainer_resume_bit_exact(tmp_path, toy_dataset):
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.trainers import ADAG
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2}, input_shape=(8,))
+
+    def make(num_epoch):
+        return ADAG(Model.init(spec, seed=0), loss="categorical_crossentropy",
+                    batch_size=16, num_epoch=num_epoch, num_workers=4,
+                    communication_window=2, seed=3)
+
+    straight = make(2).train(toy_dataset)
+    ckpt_dir = str(tmp_path / "ck")
+    make(1).train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
+    resumed = make(2).train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
+    tree_equal(straight.params, resumed.params)
