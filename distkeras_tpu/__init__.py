@@ -36,6 +36,15 @@ from distkeras_tpu.trainers import (  # noqa: F401
     AveragingTrainer,
     EnsembleTrainer,
 )
+from distkeras_tpu.runtime.async_trainer import (  # noqa: F401
+    AsyncADAG,
+    AsyncAEASGD,
+    AsyncDistributedTrainer,
+    AsyncDOWNPOUR,
+    AsyncDynSGD,
+    AsyncEAMSGD,
+)
+from distkeras_tpu.checkpoint import Checkpointer  # noqa: F401
 from distkeras_tpu.data.dataset import Dataset  # noqa: F401
 from distkeras_tpu.models.base import Model, ModelSpec  # noqa: F401
 from distkeras_tpu.predictors import ModelPredictor  # noqa: F401

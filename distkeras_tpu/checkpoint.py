@@ -101,6 +101,8 @@ class Checkpointer:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = os.path.abspath(directory)
         self.keep = int(keep)
+        if self.keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep} (a save must survive its own retention)")
         os.makedirs(self.directory, exist_ok=True)
 
     # -- enumeration -----------------------------------------------------------

@@ -55,6 +55,18 @@ class Dataset:
         """Row shuffle (reference: ``utils.shuffle`` before repartitioning)."""
         return Dataset(utils.shuffle_arrays(self._columns, seed=seed))
 
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Contiguous row shard ``index`` of ``num_shards`` (reference:
+        ``df.repartition(num_workers)`` handing each worker one partition).
+        Equal-size shards; the tail remainder is dropped so every worker
+        sees the same number of rows."""
+        if not 0 <= index < num_shards:
+            raise ValueError(f"shard index {index} out of range for {num_shards} shards")
+        per = len(self) // num_shards
+        if per == 0:
+            raise ValueError(f"dataset of {len(self)} rows cannot be split into {num_shards} shards")
+        return Dataset({k: v[index * per:(index + 1) * per] for k, v in self._columns.items()})
+
     def split(self, fraction: float, seed: Optional[int] = None) -> Sequence["Dataset"]:
         """Random (train, test)-style split; reference: ``df.randomSplit``."""
         ds = self.shuffle(seed) if seed is not None else self

@@ -1,0 +1,35 @@
+"""Host-side runtime: networking, parameter-server hub, async trainers.
+
+This package is the re-design of the reference's L3 communication layer
+(``distkeras/networking.py`` + ``distkeras/parameter_servers.py``,
+SURVEY.md §2.11–2.12) for deployments where the *synchronous on-chip*
+re-expression of the algorithms (``distkeras_tpu.parallel``) is not
+enough — genuine asynchrony across host processes over DCN, and the
+Punchcard-style job-submission plane.
+
+Two interchangeable parameter-server hubs speak one wire protocol:
+
+- :mod:`distkeras_tpu.runtime.parameter_server` — pure-Python hub
+  (thread per connection, like the reference — but pickle-free).
+- :mod:`distkeras_tpu.runtime.native` — the same hub in C++
+  (``native/ps_server.cpp``), loaded via ctypes: commits apply without
+  the GIL, so concurrent workers do not serialize on the interpreter.
+"""
+
+from distkeras_tpu.runtime.networking import (  # noqa: F401
+    connect,
+    determine_host_address,
+    recv_frame,
+    recv_json,
+    recv_tensors,
+    send_frame,
+    send_json,
+    send_tensors,
+)
+from distkeras_tpu.runtime.parameter_server import (  # noqa: F401
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    PSClient,
+    SocketParameterServer,
+)

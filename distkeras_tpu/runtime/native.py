@@ -1,0 +1,156 @@
+"""ctypes bindings for the C++ parameter-server hub (``native/ps_server.cpp``).
+
+The shared library is built on demand with ``g++`` (no pybind11 in this
+environment — plain ``extern "C"`` + ctypes) and cached next to this file;
+rebuilds happen only when the source is newer than the binary.  If no
+toolchain is available, callers fall back to the pure-Python hub — the two
+implementations speak the same wire protocol, so
+:class:`distkeras_tpu.runtime.parameter_server.PSClient` works against
+either.
+
+``NativeParameterServer`` mirrors the Python ``SocketParameterServer``
+surface (``start``/``stop``/``get_weights``/``num_updates``/``port``) so
+the async trainers can swap hubs with a constructor flag.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native", "ps_server.cpp")
+_LIB = os.path.join(_HERE, "_native_ps.so")
+
+MODE_DELTA = 0   # center += d              (DOWNPOUR, elastic)
+MODE_ADAG = 1    # center += d/num_workers  (ADAG)
+MODE_DYNSGD = 2  # center += d/(staleness+1)
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if missing/stale. Returns an error string
+    on failure, None on success."""
+    if not os.path.exists(_SRC):
+        return f"native source not found: {_SRC}"
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return None
+    # compile to a private temp path, then atomically rename into place:
+    # a concurrent process either dlopens the complete old .so or the
+    # complete new one, never a half-written file
+    tmp = f"{_LIB}.build-{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ invocation failed: {e}"
+    if proc.returncode != 0:
+        return f"g++ failed:\n{proc.stderr}"
+    os.replace(tmp, _LIB)
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.dk_ps_create.restype = ctypes.c_void_p
+        lib.dk_ps_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+        lib.dk_ps_start.restype = ctypes.c_int
+        lib.dk_ps_start.argtypes = [ctypes.c_void_p]
+        lib.dk_ps_stop.argtypes = [ctypes.c_void_p]
+        lib.dk_ps_get_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.dk_ps_set_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.dk_ps_num_updates.restype = ctypes.c_int64
+        lib.dk_ps_num_updates.argtypes = [ctypes.c_void_p]
+        lib.dk_ps_port.restype = ctypes.c_int
+        lib.dk_ps_port.argtypes = [ctypes.c_void_p]
+        lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeParameterServer:
+    """C++ PS hub with the Python hub's interface.  ``mode`` selects the
+    commit-scaling rule (MODE_DELTA / MODE_ADAG / MODE_DYNSGD)."""
+
+    def __init__(self, weights: Sequence[np.ndarray], mode: int = MODE_DELTA,
+                 num_workers: int = 1, port: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native PS unavailable: {_build_error}")
+        self._lib = lib
+        self._templates = [np.array(w, dtype=np.float32) for w in weights]
+        sizes = (ctypes.c_int64 * len(self._templates))(*[t.size for t in self._templates])
+        self._handle = lib.dk_ps_create(int(port), len(self._templates), sizes,
+                                        int(mode), int(num_workers))
+        if not self._handle:
+            raise RuntimeError("dk_ps_create failed")
+        flat = np.concatenate([t.reshape(-1) for t in self._templates]) if self._templates \
+            else np.zeros(0, np.float32)
+        self._total = int(flat.size)
+        lib.dk_ps_set_weights(self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self.port = -1
+        self._started = False
+
+    def start(self) -> None:
+        port = self._lib.dk_ps_start(self._handle)
+        if port < 0:
+            raise RuntimeError("native PS failed to bind")
+        self.port = port
+        self._started = True
+
+    def stop(self) -> None:
+        if self._started:
+            self._lib.dk_ps_stop(self._handle)
+            self._started = False
+
+    def get_weights(self) -> List[np.ndarray]:
+        out = np.zeros(self._total, np.float32)
+        self._lib.dk_ps_get_weights(self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        result = []
+        off = 0
+        for t in self._templates:
+            result.append(out[off:off + t.size].reshape(t.shape).copy())
+            off += t.size
+        return result
+
+    @property
+    def num_updates(self) -> int:
+        return int(self._lib.dk_ps_num_updates(self._handle))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                if self._started:
+                    self._lib.dk_ps_stop(self._handle)
+                self._lib.dk_ps_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass

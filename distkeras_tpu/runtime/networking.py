@@ -1,0 +1,148 @@
+"""Framed socket transport — reference parity for ``distkeras/networking.py``.
+
+The reference framed **pickled** objects with a length prefix over TCP
+(``send_data``/``recv_data``; SURVEY.md §2.12).  Pickle executes arbitrary
+code at load time, so this re-design keeps the framing but replaces the
+payload encodings with two safe forms:
+
+- **JSON frames** (:func:`send_json` / :func:`recv_json`) for control-plane
+  messages (job submission, PS handshakes).
+- **Tensor frames** (:func:`send_tensors` / :func:`recv_tensors`) for the
+  gradient plane: a 1-byte action tag + raw tensor byte blobs.  Dtype and
+  shape travel out-of-band (both ends hold the model template), keeping the
+  hot path a straight ``memcpy`` — this exact layout is also what the C++
+  hub (``native/ps_server.cpp``) parses.
+
+Wire format (all integers big-endian):
+
+    frame        := u64 payload_len, payload
+    json payload := utf-8 JSON bytes
+    tensor payload := u8 action, u32 num_tensors,
+                      num_tensors * (u64 nbytes, raw bytes)
+
+Actions: ``P`` pull request, ``C`` commit, ``B`` bye,
+``W`` weights reply, ``A`` ack.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAX_FRAME = 1 << 34  # 16 GiB sanity bound on a single frame
+
+ACTION_PULL = b"P"
+ACTION_COMMIT = b"C"
+ACTION_BYE = b"B"
+ACTION_WEIGHTS = b"W"
+ACTION_ACK = b"A"
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (reference:
+    ``networking.determine_host_address``).  Uses a connected UDP socket so
+    no traffic is actually sent."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def connect(host: str, port: int, disable_nagle: bool = True, timeout: Optional[float] = None) -> socket.socket:
+    """TCP connect (reference: ``networking.connect``); Nagle off by default —
+    the PS exchange is request/response and latency-bound."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    if disable_nagle:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError(f"peer closed mid-frame ({got}/{n} bytes)")
+        got += r
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME={MAX_FRAME}")
+    return _recv_exact(sock, n)
+
+
+# -- control plane: JSON frames -----------------------------------------------
+
+def send_json(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    send_frame(sock, json.dumps(obj).encode("utf-8"))
+
+
+def recv_json(sock: socket.socket) -> Dict[str, Any]:
+    return json.loads(recv_frame(sock).decode("utf-8"))
+
+
+# -- gradient plane: action + raw tensor frames -------------------------------
+
+def encode_tensors(action: bytes, arrays: Sequence[np.ndarray]) -> bytes:
+    parts = [action, struct.pack(">I", len(arrays))]
+    for a in arrays:
+        raw = np.ascontiguousarray(a).tobytes()
+        parts.append(struct.pack(">Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_tensors(payload: bytes) -> Tuple[bytes, List[bytes]]:
+    action = payload[0:1]
+    (count,) = struct.unpack(">I", payload[1:5])
+    blobs: List[bytes] = []
+    off = 5
+    for _ in range(count):
+        (nbytes,) = struct.unpack(">Q", payload[off:off + 8])
+        off += 8
+        blobs.append(payload[off:off + nbytes])
+        off += nbytes
+    if off != len(payload):
+        raise ValueError(f"tensor frame has {len(payload) - off} trailing bytes")
+    return action, blobs
+
+
+def send_tensors(sock: socket.socket, action: bytes, arrays: Sequence[np.ndarray]) -> None:
+    send_frame(sock, encode_tensors(action, arrays))
+
+
+def recv_tensors(sock: socket.socket, templates: Optional[Sequence[np.ndarray]] = None
+                 ) -> Tuple[bytes, List[np.ndarray]]:
+    """Receive an (action, tensors) frame.  With ``templates``, each blob is
+    reinterpreted with the template's dtype/shape (the out-of-band schema);
+    without, raw ``uint8`` arrays are returned."""
+    action, blobs = decode_tensors(recv_frame(sock))
+    if templates is None:
+        return action, [np.frombuffer(b, dtype=np.uint8) for b in blobs]
+    if len(blobs) != len(templates):
+        raise ValueError(f"got {len(blobs)} tensors, template has {len(templates)}")
+    out = []
+    for blob, tmpl in zip(blobs, templates):
+        t = np.asarray(tmpl)
+        arr = np.frombuffer(blob, dtype=t.dtype)
+        if arr.size != t.size:
+            raise ValueError(f"tensor size {arr.size} != template size {t.size}")
+        out.append(arr.reshape(t.shape))
+    return action, out

@@ -21,9 +21,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--trainer", default="adag",
                         choices=["single", "adag", "downpour", "aeasgd", "eamsgd", "dynsgd",
-                                 "averaging", "ensemble"])
+                                 "averaging", "ensemble",
+                                 "async-downpour", "async-adag", "async-aeasgd",
+                                 "async-eamsgd", "async-dynsgd"])
     parser.add_argument("--cpu", type=int, default=0,
                         help="simulate this many CPU devices instead of real chips")
+    parser.add_argument("--native-ps", action="store_true",
+                        help="async trainers: use the C++ parameter-server hub")
     parser.add_argument("--epochs", type=int, default=5)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--workers", type=int, default=None)
@@ -38,7 +42,8 @@ def main() -> None:
     import numpy as np
 
     from distkeras_tpu import (
-        ADAG, AEASGD, DOWNPOUR, AccuracyEvaluator, AveragingTrainer, Dataset,
+        ADAG, AEASGD, DOWNPOUR, AccuracyEvaluator, AsyncADAG, AsyncAEASGD,
+        AsyncDOWNPOUR, AsyncDynSGD, AsyncEAMSGD, AveragingTrainer, Dataset,
         DynSGD, EAMSGD, EnsembleTrainer, ModelPredictor, SingleTrainer,
     )
     from distkeras_tpu.data.transformers import LabelIndexTransformer, MinMaxTransformer, OneHotTransformer
@@ -78,6 +83,18 @@ def main() -> None:
         "averaging": lambda: AveragingTrainer(spec, **common, num_workers=args.workers),
         "ensemble": lambda: EnsembleTrainer(spec, **common, num_workers=args.workers),
     }
+    # genuinely-async family: host-loop workers racing a PS hub (optionally
+    # the C++ one); num_workers defaults to 4 host threads
+    adist = dict(num_workers=args.workers or 4, communication_window=4,
+                 native_ps=args.native_ps)
+    trainers.update({
+        "async-downpour": lambda: AsyncDOWNPOUR(spec, **common, **adist),
+        "async-adag": lambda: AsyncADAG(spec, **common, **adist),
+        "async-aeasgd": lambda: AsyncAEASGD(spec, rho=1.0, **common, **adist),
+        "async-eamsgd": lambda: AsyncEAMSGD(
+            spec, rho=1.0, momentum=0.9, **{**common, "worker_optimizer": "nesterov"}, **adist),
+        "async-dynsgd": lambda: AsyncDynSGD(spec, **common, **adist),
+    })
     trainer = trainers[args.trainer]()
     result = trainer.train(train_ds)
     model = result[0] if isinstance(result, list) else result

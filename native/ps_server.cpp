@@ -1,0 +1,303 @@
+// Native parameter-server hub — C++ implementation of the framed tensor
+// protocol in distkeras_tpu/runtime/networking.py (the executable spec is
+// the Python SocketParameterServer; both speak identical bytes).
+//
+// Reference parity: distkeras/parameter_servers.py ran this hub as Python
+// threads, so every commit serialized on the GIL (SURVEY.md §3.4 — "one
+// thread per worker connection + one global lock, effectively serialized
+// by the GIL").  Here accept/handler threads are native, commits apply
+// under one std::mutex with vectorizable float loops, and the Python
+// process only touches the hub at start/stop/get_weights.
+//
+// Wire format (all integers big-endian):
+//   frame          := u64 payload_len, payload
+//   tensor payload := u8 action, u32 num_tensors,
+//                     num_tensors * (u64 nbytes, raw bytes)
+//   actions: 'P' pull -> 'W' + center tensors
+//            'C' commit (center-shaped f32 deltas) -> 'A'
+//            'B' bye -> connection closes
+//
+// Commit scaling modes (matching runtime/parameter_server.py):
+//   0 delta:  center += d                (DOWNPOUR, elastic)
+//   1 adag:   center += d / num_workers  (ADAG)
+//   2 dynsgd: center += d / (staleness+1), staleness = clock - last_pull_clock
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMaxFrame = 1ULL << 34;  // 16 GiB, matches MAX_FRAME
+
+uint64_t be64_decode(const unsigned char* b) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | b[i];
+  return v;
+}
+
+void be64_encode(uint64_t v, unsigned char* b) {
+  for (int i = 7; i >= 0; --i) { b[i] = v & 0xff; v >>= 8; }
+}
+
+uint32_t be32_decode(const unsigned char* b) {
+  return (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) | (uint32_t(b[2]) << 8) | b[3];
+}
+
+void be32_encode(uint32_t v, unsigned char* b) {
+  b[0] = v >> 24; b[1] = (v >> 16) & 0xff; b[2] = (v >> 8) & 0xff; b[3] = v & 0xff;
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<unsigned char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += size_t(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const unsigned char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += size_t(r);
+  }
+  return true;
+}
+
+class ParameterServer {
+ public:
+  ParameterServer(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers)
+      : requested_port_(port), mode_(mode), num_workers_(num_workers) {
+    sizes_.assign(sizes, sizes + num_tensors);
+    int64_t total = 0;
+    for (int64_t s : sizes_) total += s;
+    center_.assign(size_t(total), 0.0f);
+  }
+
+  ~ParameterServer() { stop(); }
+
+  // returns the bound port, or -1 on failure
+  int start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(uint16_t(requested_port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    bound_port_ = ntohs(addr.sin_port);
+    running_.store(true);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return bound_port_;
+  }
+
+  void stop() {
+    bool was_running = running_.exchange(false);
+    if (!was_running && listen_fd_ < 0) return;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(conn_mutex_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : handler_threads_)
+      if (t.joinable()) t.join();
+    handler_threads_.clear();
+  }
+
+  void get_weights(float* out) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(out, center_.data(), center_.size() * sizeof(float));
+  }
+
+  void set_weights(const float* in) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(center_.data(), in, center_.size() * sizeof(float));
+  }
+
+  int64_t num_updates() const { return num_updates_.load(); }
+  int port() const { return bound_port_; }
+
+ private:
+  void accept_loop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;  // listener closed by stop()
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mutex_);
+      conn_fds_.push_back(fd);
+      handler_threads_.emplace_back([this, fd] { handle_connection(fd); });
+    }
+  }
+
+  bool recv_payload(int fd, std::vector<unsigned char>& payload) {
+    unsigned char hdr[8];
+    if (!read_exact(fd, hdr, 8)) return false;
+    uint64_t n = be64_decode(hdr);
+    if (n > kMaxFrame) return false;
+    payload.resize(size_t(n));
+    return n == 0 || read_exact(fd, payload.data(), size_t(n));
+  }
+
+  bool send_simple(int fd, char action) {
+    unsigned char buf[8 + 1 + 4];
+    be64_encode(5, buf);
+    buf[8] = static_cast<unsigned char>(action);
+    be32_encode(0, buf + 9);
+    return write_all(fd, buf, sizeof(buf));
+  }
+
+  bool send_weights(int fd, const std::vector<float>& snap) {
+    uint64_t payload_len = 1 + 4;
+    for (int64_t s : sizes_) payload_len += 8 + uint64_t(s) * sizeof(float);
+    std::vector<unsigned char> buf(8 + payload_len);
+    be64_encode(payload_len, buf.data());
+    size_t off = 8;
+    buf[off++] = 'W';
+    be32_encode(uint32_t(sizes_.size()), buf.data() + off);
+    off += 4;
+    const float* src = snap.data();
+    for (int64_t s : sizes_) {
+      uint64_t nbytes = uint64_t(s) * sizeof(float);
+      be64_encode(nbytes, buf.data() + off);
+      off += 8;
+      std::memcpy(buf.data() + off, src, nbytes);
+      off += nbytes;
+      src += s;
+    }
+    return write_all(fd, buf.data(), buf.size());
+  }
+
+  // parse a commit payload: validates tensor count/sizes against center_
+  bool parse_commit(const std::vector<unsigned char>& payload, const float** delta_out) {
+    if (payload.size() < 5) return false;
+    uint32_t count = be32_decode(payload.data() + 1);
+    if (count != sizes_.size()) return false;
+    size_t off = 5;
+    for (uint32_t i = 0; i < count; ++i) {
+      if (off + 8 > payload.size()) return false;
+      uint64_t nbytes = be64_decode(payload.data() + off);
+      off += 8;
+      if (nbytes != uint64_t(sizes_[i]) * sizeof(float)) return false;
+      if (off + nbytes > payload.size()) return false;
+      delta_out[i] = reinterpret_cast<const float*>(payload.data() + off);
+      off += nbytes;
+    }
+    return off == payload.size();
+  }
+
+  void apply_commit(const float** delta, int64_t staleness) {
+    float scale = 1.0f;
+    if (mode_ == 1) scale = 1.0f / float(num_workers_);
+    else if (mode_ == 2) scale = 1.0f / float(staleness + 1);
+    float* c = center_.data();
+    for (size_t i = 0; i < sizes_.size(); ++i) {
+      const float* d = delta[i];
+      int64_t n = sizes_[i];
+      for (int64_t j = 0; j < n; ++j) c[j] += scale * d[j];
+      c += n;
+    }
+  }
+
+  void handle_connection(int fd) {
+    int64_t last_pull_clock = 0;
+    std::vector<unsigned char> payload;
+    std::vector<const float*> delta(sizes_.size());
+    std::vector<float> snap;
+    while (running_.load()) {
+      if (!recv_payload(fd, payload) || payload.empty()) break;
+      char action = char(payload[0]);
+      if (action == 'P') {
+        {
+          // clock read and center snapshot must be ONE critical section:
+          // a commit landing between them would make the snapshot newer
+          // than the recorded clock and overstate DynSGD staleness
+          std::lock_guard<std::mutex> g(center_mutex_);
+          last_pull_clock = clock_;
+          snap = center_;
+        }
+        if (!send_weights(fd, snap)) break;
+      } else if (action == 'C') {
+        if (!parse_commit(payload, delta.data())) break;
+        {
+          std::lock_guard<std::mutex> g(center_mutex_);
+          apply_commit(delta.data(), clock_ - last_pull_clock);
+          ++clock_;
+        }
+        num_updates_.fetch_add(1);
+        if (!send_simple(fd, 'A')) break;
+      } else {  // 'B' or unknown -> close
+        break;
+      }
+    }
+    ::close(fd);
+    // forget the fd so stop() can't shutdown() a future unrelated socket
+    // that reuses this descriptor number
+    std::lock_guard<std::mutex> g(conn_mutex_);
+    conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd), conn_fds_.end());
+  }
+
+  int requested_port_;
+  int bound_port_ = -1;
+  int mode_;
+  int num_workers_;
+  std::vector<int64_t> sizes_;
+  std::vector<float> center_;
+  std::mutex center_mutex_;
+  int64_t clock_ = 0;
+  std::atomic<int64_t> num_updates_{0};
+  std::atomic<bool> running_{false};
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex conn_mutex_;
+  std::vector<int> conn_fds_;
+  std::vector<std::thread> handler_threads_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dk_ps_create(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers) {
+  return new ParameterServer(port, num_tensors, sizes, mode, num_workers);
+}
+
+int dk_ps_start(void* ps) { return static_cast<ParameterServer*>(ps)->start(); }
+void dk_ps_stop(void* ps) { static_cast<ParameterServer*>(ps)->stop(); }
+void dk_ps_get_weights(void* ps, float* out) { static_cast<ParameterServer*>(ps)->get_weights(out); }
+void dk_ps_set_weights(void* ps, const float* in) { static_cast<ParameterServer*>(ps)->set_weights(in); }
+int64_t dk_ps_num_updates(void* ps) { return static_cast<ParameterServer*>(ps)->num_updates(); }
+int dk_ps_port(void* ps) { return static_cast<ParameterServer*>(ps)->port(); }
+void dk_ps_destroy(void* ps) { delete static_cast<ParameterServer*>(ps); }
+
+}  // extern "C"

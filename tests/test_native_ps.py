@@ -1,0 +1,128 @@
+"""Native (C++) parameter-server hub tests: the Python PSClient drives the
+C++ server over the shared wire protocol, and results must match the
+pure-Python hub bit-for-bit on deterministic schedules."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.runtime.native import (
+    MODE_ADAG,
+    MODE_DELTA,
+    MODE_DYNSGD,
+    NativeParameterServer,
+    build_error,
+    native_available,
+)
+from distkeras_tpu.runtime.parameter_server import PSClient
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason=f"native PS unavailable: {build_error()}")
+
+
+def _weights():
+    return [np.zeros((2, 2), np.float32), np.zeros((3,), np.float32)]
+
+
+def test_native_pull_commit_roundtrip():
+    ps = NativeParameterServer(_weights(), mode=MODE_DELTA)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=_weights()) as c:
+            w = c.pull()
+            assert all(np.all(x == 0) for x in w)
+            c.commit([np.ones((2, 2), np.float32), 2 * np.ones((3,), np.float32)])
+            w = c.pull()
+            np.testing.assert_allclose(w[0], np.ones((2, 2)))
+            np.testing.assert_allclose(w[1], 2 * np.ones((3,)))
+        assert ps.num_updates == 1
+    finally:
+        ps.stop()
+
+
+def test_native_initial_weights_preserved():
+    init = [np.full((2, 2), 3.0, np.float32), np.arange(3, dtype=np.float32)]
+    ps = NativeParameterServer(init, mode=MODE_DELTA)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=init) as c:
+            w = c.pull()
+            np.testing.assert_allclose(w[0], init[0])
+            np.testing.assert_allclose(w[1], init[1])
+    finally:
+        ps.stop()
+
+
+def test_native_adag_scaling():
+    ps = NativeParameterServer(_weights(), mode=MODE_ADAG, num_workers=4)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=_weights()) as c:
+            c.commit([np.full((2, 2), 4.0, np.float32), np.full((3,), 8.0, np.float32)])
+            w = c.pull()
+            np.testing.assert_allclose(w[0], np.ones((2, 2)))
+            np.testing.assert_allclose(w[1], 2 * np.ones((3,)))
+    finally:
+        ps.stop()
+
+
+def test_native_dynsgd_staleness():
+    ps = NativeParameterServer(_weights(), mode=MODE_DYNSGD)
+    ps.start()
+    try:
+        a = PSClient("127.0.0.1", ps.port, templates=_weights())
+        b = PSClient("127.0.0.1", ps.port, templates=_weights())
+        a.pull()
+        b.pull()
+        one = [np.ones((2, 2), np.float32), np.ones((3,), np.float32)]
+        a.commit(one)  # staleness 0 -> full
+        b.commit(one)  # staleness 1 -> half
+        w = a.pull()
+        np.testing.assert_allclose(w[0], np.full((2, 2), 1.5))
+        a.close()
+        b.close()
+    finally:
+        ps.stop()
+
+
+def test_native_concurrent_commits_all_land():
+    ps = NativeParameterServer([np.zeros((64,), np.float32)], mode=MODE_DELTA)
+    ps.start()
+    n_workers, n_commits = 8, 50
+
+    def work(i):
+        with PSClient("127.0.0.1", ps.port, templates=[np.zeros((64,), np.float32)]) as c:
+            for _ in range(n_commits):
+                c.pull()
+                c.commit([np.ones((64,), np.float32)])
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        np.testing.assert_allclose(ps.get_weights()[0], np.full((64,), n_workers * n_commits))
+        assert ps.num_updates == n_workers * n_commits
+    finally:
+        ps.stop()
+
+
+def test_native_async_downpour_trains(toy_dataset):
+    from distkeras_tpu import AsyncDOWNPOUR
+    from distkeras_tpu.data.transformers import LabelIndexTransformer
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.predictors import ModelPredictor
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2}, input_shape=(8,))
+    trainer = AsyncDOWNPOUR(Model.init(spec, seed=0), loss="categorical_crossentropy",
+                            batch_size=16, num_epoch=2, num_workers=4,
+                            communication_window=4, learning_rate=0.05, native_ps=True)
+    model = trainer.train(toy_dataset)
+    assert trainer.parameter_server.num_updates > 0
+    ds = ModelPredictor(model, features_col="features").predict(toy_dataset)
+    ds = LabelIndexTransformer().transform(ds)
+    acc = AccuracyEvaluator(prediction_col="prediction_index", label_col="label_index").evaluate(ds)
+    assert acc > 0.9, f"native AsyncDOWNPOUR accuracy {acc}"

@@ -1,0 +1,163 @@
+"""Runtime layer tests: framed transport, PS hub semantics, async trainers.
+
+Covers the reference's L3 (SURVEY.md §2.11–2.12) — here pickle-free and
+with the genuinely-asynchronous trainer family on top."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.runtime import networking as net
+from distkeras_tpu.runtime.parameter_server import (
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    PSClient,
+)
+
+
+# -- framing ------------------------------------------------------------------
+
+def test_tensor_frame_roundtrip():
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3), np.ones((4,), np.float32)]
+    payload = net.encode_tensors(net.ACTION_COMMIT, arrays)
+    action, blobs = net.decode_tensors(payload)
+    assert action == net.ACTION_COMMIT
+    assert len(blobs) == 2
+    np.testing.assert_array_equal(np.frombuffer(blobs[0], np.float32).reshape(2, 3), arrays[0])
+
+
+def test_tensor_frame_trailing_bytes_rejected():
+    payload = net.encode_tensors(net.ACTION_PULL, []) + b"junk"
+    with pytest.raises(ValueError, match="trailing"):
+        net.decode_tensors(payload)
+
+
+def test_json_frames_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        net.send_json(a, {"action": "submit", "job": "mnist", "n": 3})
+        msg = net.recv_json(b)
+        assert msg == {"action": "submit", "job": "mnist", "n": 3}
+    finally:
+        a.close()
+        b.close()
+
+
+# -- parameter servers --------------------------------------------------------
+
+def _weights():
+    return [np.zeros((2, 2), np.float32), np.zeros((3,), np.float32)]
+
+
+def test_delta_ps_pull_commit():
+    ps = DeltaParameterServer(_weights())
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=_weights()) as c:
+            w = c.pull()
+            assert all(np.all(x == 0) for x in w)
+            c.commit([np.ones((2, 2), np.float32), 2 * np.ones((3,), np.float32)])
+            w = c.pull()
+            np.testing.assert_allclose(w[0], np.ones((2, 2)))
+            np.testing.assert_allclose(w[1], 2 * np.ones((3,)))
+        assert ps.num_updates == 1
+    finally:
+        ps.stop()
+
+
+def test_adag_ps_normalizes_by_num_workers():
+    ps = ADAGParameterServer(_weights(), num_workers=4)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=_weights()) as c:
+            c.commit([np.full((2, 2), 4.0, np.float32), np.full((3,), 8.0, np.float32)])
+            w = c.pull()
+            np.testing.assert_allclose(w[0], np.ones((2, 2)))
+            np.testing.assert_allclose(w[1], 2 * np.ones((3,)))
+    finally:
+        ps.stop()
+
+
+def test_dynsgd_staleness_scaling():
+    """Worker B pulls, then A's commit lands first: B's commit has
+    staleness 1 and is scaled by 1/2 (reference DynSGD rule)."""
+    ps = DynSGDParameterServer(_weights())
+    ps.start()
+    try:
+        a = PSClient("127.0.0.1", ps.port, templates=_weights())
+        b = PSClient("127.0.0.1", ps.port, templates=_weights())
+        a.pull()
+        b.pull()
+        one = [np.ones((2, 2), np.float32), np.ones((3,), np.float32)]
+        a.commit(one)  # staleness 0 -> full
+        b.commit(one)  # staleness 1 -> half
+        w = a.pull()
+        np.testing.assert_allclose(w[0], np.full((2, 2), 1.5))
+        a.close()
+        b.close()
+    finally:
+        ps.stop()
+
+
+def test_concurrent_commits_all_land():
+    ps = DeltaParameterServer([np.zeros((16,), np.float32)])
+    ps.start()
+    n_workers, n_commits = 8, 20
+
+    def work(i):
+        with PSClient("127.0.0.1", ps.port, templates=[np.zeros((16,), np.float32)]) as c:
+            for _ in range(n_commits):
+                c.pull()
+                c.commit([np.ones((16,), np.float32)])
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        np.testing.assert_allclose(ps.get_weights()[0], np.full((16,), n_workers * n_commits))
+        assert ps.num_updates == n_workers * n_commits
+    finally:
+        ps.stop()
+
+
+def test_client_size_mismatch_raises():
+    ps = DeltaParameterServer(_weights())
+    ps.start()
+    try:
+        c = PSClient("127.0.0.1", ps.port, templates=[np.zeros((5,), np.float32)])
+        with pytest.raises((ValueError, ConnectionError)):
+            c.pull()
+        c.sock.close()
+    finally:
+        ps.stop()
+
+
+# -- async trainers -----------------------------------------------------------
+
+@pytest.mark.parametrize("trainer_name", ["AsyncDOWNPOUR", "AsyncADAG", "AsyncAEASGD", "AsyncDynSGD"])
+def test_async_trainers_learn(trainer_name, toy_dataset):
+    import distkeras_tpu as dk
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.predictors import ModelPredictor
+    from distkeras_tpu.data.transformers import LabelIndexTransformer
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2}, input_shape=(8,))
+    cls = getattr(dk, trainer_name)
+    kwargs = dict(loss="categorical_crossentropy", batch_size=16, num_epoch=2,
+                  num_workers=4, communication_window=4, learning_rate=0.05, seed=0)
+    if trainer_name in ("AsyncAEASGD",):
+        kwargs["rho"] = 2.0
+    trainer = cls(Model.init(spec, seed=0), **kwargs)
+    model = trainer.train(toy_dataset)
+    assert trainer.parameter_server.num_updates > 0
+    ds = ModelPredictor(model, features_col="features").predict(toy_dataset)
+    ds = LabelIndexTransformer().transform(ds)
+    acc = AccuracyEvaluator(prediction_col="prediction_index", label_col="label_index").evaluate(ds)
+    assert acc > 0.9, f"{trainer_name} accuracy {acc}"
+    assert len(trainer.history) > 0
