@@ -23,6 +23,9 @@ class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     seq_axis: Optional[str] = None  # mesh axis name for ring attention
+    attn_impl: Optional[str] = None  # None=auto | "flash" (pallas) | "dense";
+                                     # must stay None when seq_axis is set
+                                     # (ring attention governs that path)
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -35,7 +38,7 @@ class TransformerBlock(nn.Module):
         q = q.reshape(b, l, self.num_heads, head_dim)
         k = k.reshape(b, l, self.num_heads, head_dim)
         v = v.reshape(b, l, self.num_heads, head_dim)
-        o = attention(q, k, v, causal=True, axis_name=self.seq_axis)
+        o = attention(q, k, v, causal=True, axis_name=self.seq_axis, impl=self.attn_impl)
         o = o.reshape(b, l, self.model_dim)
         x = x + nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="proj")(o)
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
@@ -63,6 +66,7 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     mlp_ratio: int = 4
     seq_axis: Optional[str] = None
+    attn_impl: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -79,6 +83,7 @@ class TransformerLM(nn.Module):
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis,
+                attn_impl=self.attn_impl,
                 compute_dtype=self.compute_dtype,
                 name=f"block_{i}",
             )(x)

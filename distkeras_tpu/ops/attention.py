@@ -36,7 +36,14 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool
         mask = q_pos[:, None] >= k_pos[None, :]
         logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if causal:
+        # rows with no visible key (q_offset < k_offset shards) output exactly
+        # 0 — softmax of an all-masked row would otherwise emit uniform(V);
+        # ring/flash attention both use the zero convention
+        any_visible = mask.any(axis=-1)  # [Lq]
+        out = jnp.where(any_visible[None, :, None, None], out, 0)
+    return out
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: str,
@@ -91,7 +98,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: st
     return (acc / denom).astype(q.dtype)
 
 
-def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None):
+def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
+              impl: Optional[str] = None):
     """Dispatch: ring attention when a sequence mesh axis is given, else dense.
 
     A sequence-parallel model traced outside ``shard_map`` (e.g. parameter
@@ -101,10 +109,34 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None):
     bound at all: inside a shard_map whose axes don't include ``axis_name``,
     falling back would silently attend within each local shard, so that is
     an error instead.
+
+    ``impl``: ``"flash"`` forces the Pallas flash kernel on the dense path,
+    ``"dense"`` forces plain XLA softmax attention, ``None`` auto-selects
+    flash on TPU for sequences long enough to benefit (the kernel skips
+    masked key blocks and never materializes [Lq, Lk]).
     """
+    if axis_name is not None and jax.typeof(q).vma:
+        # sequence-parallel path: the schedule is ring attention; a forced
+        # per-block impl is not honored here, so reject rather than ignore
+        if impl is not None:
+            raise ValueError(
+                f"impl={impl!r} is not supported under sequence parallelism "
+                f"(axis {axis_name!r} is bound): the schedule is ring attention")
     if axis_name is not None and not jax.typeof(q).vma:
         axis_name = None  # traced outside any shard_map: dense is exact
     if axis_name is None:
+        if impl is None:
+            # flash needs Mosaic-legal blocks and enough rows per block to
+            # beat XLA's fused softmax-attention; 128-divisible covers both
+            impl = ("flash" if (jax.default_backend() == "tpu" and q.shape[1] >= 512
+                                and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
+                    else "dense")
+        if impl == "flash":
+            from distkeras_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal)
+        if impl != "dense":
+            raise ValueError(f"unknown attention impl {impl!r}: expected 'flash' or 'dense'")
         return dense_attention(q, k, v, causal=causal)
     try:
         lax.axis_size(axis_name)

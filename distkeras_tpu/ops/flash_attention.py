@@ -1,0 +1,347 @@
+"""Pallas TPU flash attention: the framework's hot-op kernel.
+
+The reference delegates all compute to the Keras backend and ships no
+kernels of its own (SURVEY.md §2 "Native components: none"); this module is
+the TPU-native replacement for that compute path's attention hot op, written
+directly against the MXU/VMEM model:
+
+- O(block) VMEM: the [Lq, Lk] probability matrix is never materialized and
+  no full-sequence tensor is ever resident — the kv (resp. q) position is
+  an innermost grid dimension, so Pallas streams [block, D] tiles through
+  VMEM while float32 scratch accumulators carry the online-softmax state
+  (running max / denominator / output) across grid steps.  Sequence length
+  is bounded by HBM, not VMEM.
+- MXU-shaped: matmuls run in the input dtype (bf16 x bf16 at full MXU rate)
+  with ``preferred_element_type=float32`` accumulation; only the softmax
+  statistics live in float32.
+- Causal skipping: key blocks entirely in the masked future contribute no
+  FLOPs — the per-block compute is predicated on the block's global
+  position, which also makes sharded callers (ring attention holds only a
+  sequence shard) pay only for the keys they can see.
+
+Backward pass is the standard flash recomputation: store per-row logsumexp
+in the forward; recompute block probabilities in the backward and
+accumulate dQ (grid streams kv blocks) and dK/dV (grid streams q blocks)
+in float32 scratch.
+
+Interpret mode (``interpret=True``, auto-enabled off-TPU) runs the same
+kernels through the Pallas interpreter so CPU tests exercise identical code.
+
+Layout note: kernels grid over (batch, head, outer block, inner block) on a
+[B, H, L, D] layout — Mosaic requires the last two block dims to be
+(8, 128)-tiled or equal to the array dims, so the head axis must sit
+outside them (same scheme as jax.experimental.pallas.ops.tpu
+.flash_attention).  The public entry transposes from the framework's
+[B, L, H, D]; per-row softmax stats (logsumexp, delta) are stored with a
+trailing 8-lane dim for the same tiling reason.
+
+Fully-masked query rows (possible only when ``q_offset < k_offset``) output
+exactly 0 with 0 gradient, matching ``ring_attention``'s convention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_STAT_LANES = 8  # trailing lanes for per-row stats (min f32 tile lane count
+                 # that can equal the array dim; avoids 128x padding waste)
+
+
+class _Config(NamedTuple):
+    """Static kernel configuration (hashable: custom_vjp nondiff argument)."""
+
+    causal: bool
+    q_offset: int
+    k_offset: int
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _block_visible(cfg: _Config, qi, kj):
+    """True unless key block ``kj`` is entirely in query block ``qi``'s
+    masked future (then its FLOPs are predicated away)."""
+    if not cfg.causal:
+        return True
+    last_q_pos = cfg.q_offset + (qi + 1) * cfg.block_q - 1
+    first_k_pos = cfg.k_offset + kj * cfg.block_k
+    return last_q_pos >= first_k_pos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                cfg: _Config, scale: float):
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_block_visible(cfg, qi, kj))
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d] — native dtype: bf16 x bf16 at full MXU rate
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if cfg.causal:
+            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m = m_scr[:, 0]
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m - safe_m))
+        p = jnp.exp(s - safe_m[:, None])
+        pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(new_m[:, None], m_scr.shape)
+        l_scr[...] = l_scr[...] * corr[:, None] + jnp.broadcast_to(
+            jnp.sum(p, axis=-1)[:, None], l_scr.shape)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + pv
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        m = m_scr[:, 0]
+        l_sum = l_scr[:, 0]
+        # fully-masked rows (l == 0): output exactly 0, lse 0 (a finite
+        # sentinel; the backward recomputes p = exp(-inf - 0) = 0 so grads
+        # are exactly 0)
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(l_sum, 1e-30)[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(l_sum > 0.0,
+                        jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(jnp.maximum(l_sum, 1e-30)),
+                        0.0)
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, cfg: _Config, scale: float):
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_visible(cfg, qi, kj))
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
+        delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if cfg.causal:
+            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # masked/-inf entries -> exactly 0
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                           (((1,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, cfg: _Config, scale: float):
+    kj, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_block_visible(cfg, qi, kj))
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
+        delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if cfg.causal:
+            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _forward(q, k, v, cfg: _Config):
+    """q [B, H, Lq, D], k/v [B, H, Lk, D] -> (o like q, lse [B, H, Lq, 8])."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq, bk = cfg.block_q, cfg.block_k
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_fwd_kernel, cfg=cfg, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, lq // bq, lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, lq, _STAT_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running max
+            pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),            # output accumulator
+        ],
+        interpret=cfg.interpret,
+    )(q, k, v)
+
+
+def _backward(q, k, v, o, lse, do, cfg: _Config):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq, bk = cfg.block_q, cfg.block_k
+    scale = 1.0 / (d ** 0.5)
+    # delta[b, h, i] = sum_d dO * O — the softmax-jacobian row term; tiny
+    # elementwise reduce, XLA fuses it, no kernel needed
+    delta = jnp.einsum("bhld,bhld->bhl", do.astype(jnp.float32), o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (b, h, lq, _STAT_LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, cfg=cfg, scale=scale),
+        grid=(b, h, lq // bq, lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),   # q
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),   # k
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),   # v
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),   # do
+            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, i, j: (b, h, i, 0)),  # lse
+            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, i, j: (b, h, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, cfg=cfg, scale=scale),
+        grid=(b, h, lk // bk, lq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),   # q
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),   # k
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),   # v
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),   # do
+            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # lse
+            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, cfg: _Config):
+    o, _ = _forward(q, k, v, cfg)
+    return o
+
+
+def _flash_fwd(q, k, v, cfg: _Config):
+    o, lse = _forward(q, k, v, cfg)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(cfg: _Config, res, do):
+    q, k, v, o, lse = res
+    return _backward(q, k, v, o, lse, do, cfg)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pick_block(block: int, length: int) -> int:
+    block = min(block, length)
+    while length % block:
+        block //= 2
+    return max(block, 1)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, q_offset: int = 0, k_offset: int = 0,
+                    block_q: int = 256, block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over [B, L, H, D] tensors (same layout/semantics as
+    ``ops.attention.dense_attention``, including the shard offsets).
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
+    identical kernel code runs (slowly) in CPU tests.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lq, lk = q.shape[1], k.shape[1]
+    bq, bk = _pick_block(block_q, lq), _pick_block(block_k, lk)
+    for name, blk, length in (("block_q", bq, lq), ("block_k", bk, lk)):
+        # Mosaic tiling: the sublane block dim must be 8-divisible or span
+        # the whole array dim (interpret mode is lenient, but keep semantics
+        # identical so CPU tests catch what TPU would reject)
+        if blk % 8 != 0 and blk != length:
+            raise ValueError(
+                f"no Mosaic-legal {name} for sequence length {length}: largest "
+                f"divisor <= {block_q if name == 'block_q' else block_k} is {blk}, "
+                f"which is neither 8-divisible nor the full length; pad the "
+                f"sequence or use impl='dense'")
+    cfg = _Config(causal=bool(causal), q_offset=int(q_offset), k_offset=int(k_offset),
+                  block_q=bq, block_k=bk, interpret=bool(interpret))
+    # [B, L, H, D] -> [B, H, L, D] for the kernels; the transposes sit outside
+    # the custom_vjp so their adjoints are handled by XLA
+    o = _flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2), cfg)
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,143 @@
+"""Pallas flash attention vs the dense reference implementation.
+
+Runs the real kernels through the Pallas interpreter on CPU (same code
+path as TPU modulo Mosaic lowering)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.attention import dense_attention
+from distkeras_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(rng, b=2, l=64, h=2, d=32, lk=None, dtype=np.float32):
+    lk = l if lk is None else lk
+    q = rng.normal(size=(b, l, h, d)).astype(dtype)
+    k = rng.normal(size=(b, lk, h, d)).astype(dtype)
+    v = rng.normal(size=(b, lk, h, d)).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(causal):
+    q, k, v = _rand_qkv(np.random.default_rng(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32, interpret=True)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_forward_with_offsets():
+    # flash over the second half of the queries against the full key set ==
+    # the corresponding slice of full dense attention (a ring-attention shard)
+    rng = np.random.default_rng(1)
+    q, k, v = _rand_qkv(rng, l=64)
+    q_half = q[:, 32:]
+    out = flash_attention(q_half, k, v, causal=True, q_offset=32, k_offset=0,
+                          block_q=16, block_k=16, interpret=True)
+    ref = dense_attention(q, k, v, causal=True)[:, 32:]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense(causal):
+    rng = np.random.default_rng(2)
+    q, k, v = _rand_qkv(rng, b=1, l=32, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_dense(q, k, v):
+        o = dense_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5,
+                                   err_msg=f"grad mismatch for {name}")
+
+
+def test_bfloat16_forward():
+    q, k, v = _rand_qkv(np.random.default_rng(3), d=32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, causal=True, block_q=32, block_k=32, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), np.asarray(ref),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_fully_masked_rows_zero_output_and_grads():
+    # q_offset < k_offset: the first 8 query rows precede every key — they
+    # must output exactly 0 with finite (zero) gradients, in both impls
+    rng = np.random.default_rng(5)
+    q, k, v = _rand_qkv(rng, b=1, l=16, h=1, d=16, lk=16)
+
+    out = flash_attention(q, k, v, causal=True, q_offset=0, k_offset=8,
+                          block_q=16, block_k=16, interpret=True)
+    ref = dense_attention(q, k, v, causal=True, q_offset=0, k_offset=8)
+    np.testing.assert_array_equal(np.asarray(out[:, :8]), 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss(fn):
+        def f(q, k, v):
+            if fn is flash_attention:
+                o = fn(q, k, v, causal=True, q_offset=0, k_offset=8,
+                       block_q=16, block_k=16, interpret=True)
+            else:
+                o = fn(q, k, v, causal=True, q_offset=0, k_offset=8)
+            return jnp.sum(o * o)
+        return f
+
+    gf = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss(dense_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        assert np.isfinite(np.asarray(a)).all(), f"non-finite flash grad for {name}"
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5,
+                                   err_msg=f"grad mismatch for {name}")
+
+
+def test_unknown_impl_raises():
+    from distkeras_tpu.ops.attention import attention
+
+    q, k, v = _rand_qkv(np.random.default_rng(6), l=16, d=8)
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        attention(q, k, v, impl="Flash")
+
+
+def test_mosaic_illegal_length_raises():
+    # L=513 has no 8-divisible block divisor; flash must reject it with a
+    # clear error instead of failing in Mosaic lowering
+    q, k, v = _rand_qkv(np.random.default_rng(7), l=513, d=8)
+    with pytest.raises(ValueError, match="Mosaic-legal"):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_forced_impl_under_sequence_parallelism_raises():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distkeras_tpu.ops.attention import attention
+
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("sp",))
+    q, k, v = _rand_qkv(np.random.default_rng(8), l=16, d=8)
+
+    def fn(q, k, v):
+        return attention(q, k, v, axis_name="sp", impl="dense")
+
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                            out_specs=P(None, "sp"))
+    with pytest.raises(ValueError, match="not supported under sequence parallelism"):
+        sharded(q, k, v)
+
+
+def test_odd_block_sizes_fall_back_to_divisors():
+    # L=48 with requested block 32 -> picker must choose a divisor
+    q, k, v = _rand_qkv(np.random.default_rng(4), l=48)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
