@@ -5,6 +5,18 @@ this is the TPU-native headroom model exercising the sequence-parallel
 (ring attention) and tensor-parallel paths.  Designed MXU-first: all
 matmuls are [*, model_dim] x [model_dim, *] with dims that tile 128 lanes;
 ``param_dtype`` float32 with bfloat16 activations via ``compute_dtype``.
+
+Tensor parallelism (Megatron split, expressed in shard_map types):
+- qkv is column-parallel over heads (kernel [E, 3, H, Dh], H sharded over
+  the ``tp`` mesh axis), attention runs on the local head shard;
+- proj is row-parallel (kernel [H, Dh, E]) producing a partial sum that is
+  ``psum``'d over tp;
+- MLP up is column-parallel ([E, F], F sharded), down row-parallel
+  ([F, E]) followed by the second tp ``psum``.
+Initialization always builds the FULL parameter tree (``tp_size=1``
+semantics); the training step shards it onto the mesh and applies a module
+configured with the LOCAL sizes (``tp_size=t``) inside ``shard_map`` —
+see ``parallel/lm.py :: lm_param_specs``.
 """
 
 from __future__ import annotations
@@ -12,17 +24,29 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from distkeras_tpu.models.base import register_model
 from distkeras_tpu.ops.attention import attention
 
 
+def _maybe_psum(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    """psum over ``axis_name`` when it is bound by an enclosing shard_map;
+    identity when traced outside one (init, single-device eval)."""
+    if axis_name is None or axis_name not in jax.typeof(x).vma:
+        return x
+    return lax.psum(x, axis_name)
+
+
 class TransformerBlock(nn.Module):
     model_dim: int
-    num_heads: int
+    num_heads: int            # GLOBAL head count; local = num_heads // tp_size
     mlp_ratio: int = 4
     seq_axis: Optional[str] = None  # mesh axis name for ring attention
+    tp_axis: Optional[str] = None   # mesh axis name for tensor parallelism
+    tp_size: int = 1
     attn_impl: Optional[str] = None  # None=auto | "flash" (pallas) | "dense";
                                      # must stay None when seq_axis is set
                                      # (ring attention governs that path)
@@ -30,22 +54,26 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.num_heads % self.tp_size:
+            raise ValueError(f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}")
+        heads_local = self.num_heads // self.tp_size
         head_dim = self.model_dim // self.num_heads
+        ffn_local = self.mlp_ratio * self.model_dim // self.tp_size
+
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        qkv = nn.Dense(3 * self.model_dim, use_bias=False, dtype=self.compute_dtype, name="qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        b, l = q.shape[0], q.shape[1]
-        q = q.reshape(b, l, self.num_heads, head_dim)
-        k = k.reshape(b, l, self.num_heads, head_dim)
-        v = v.reshape(b, l, self.num_heads, head_dim)
+        qkv = nn.DenseGeneral((3, heads_local, head_dim), use_bias=False,
+                              dtype=self.compute_dtype, name="qkv")(y)  # [B, L, 3, Hl, Dh]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         o = attention(q, k, v, causal=True, axis_name=self.seq_axis, impl=self.attn_impl)
-        o = o.reshape(b, l, self.model_dim)
-        x = x + nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="proj")(o)
+        o = nn.DenseGeneral(self.model_dim, axis=(-2, -1), use_bias=False,
+                            dtype=self.compute_dtype, name="proj")(o)  # [B, L, E] partial
+        x = x + _maybe_psum(o, self.tp_axis)
+
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        y = nn.Dense(self.mlp_ratio * self.model_dim, use_bias=False, dtype=self.compute_dtype, name="up")(y)
+        y = nn.Dense(ffn_local, use_bias=False, dtype=self.compute_dtype, name="up")(y)
         y = nn.gelu(y)
         y = nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="down")(y)
-        return x + y
+        return x + _maybe_psum(y, self.tp_axis)
 
 
 @register_model("transformer_lm")
@@ -56,7 +84,9 @@ class TransformerLM(nn.Module):
     with the sequence dim sharded over that axis; position embeddings are
     then indexed by global position (handled inside the block's ring
     attention; the learned positional table here is sized for the *global*
-    sequence and sliced by the caller-provided offset).
+    sequence and sliced by the caller-provided offset).  When ``tp_axis``/
+    ``tp_size`` are set the module expects the LOCAL parameter shards
+    (see module docstring).
     """
 
     vocab_size: int = 32000
@@ -66,6 +96,8 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     mlp_ratio: int = 4
     seq_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
     attn_impl: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -83,6 +115,8 @@ class TransformerLM(nn.Module):
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis,
+                tp_axis=self.tp_axis,
+                tp_size=self.tp_size,
                 attn_impl=self.attn_impl,
                 compute_dtype=self.compute_dtype,
                 name=f"block_{i}",
@@ -93,7 +127,8 @@ class TransformerLM(nn.Module):
 
 
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
-                  num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None):
+                  num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
+                  tp_axis: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
     return ModelSpec(
@@ -105,6 +140,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "num_layers": num_layers,
             "max_seq_len": max_seq_len,
             "seq_axis": seq_axis,
+            "tp_axis": tp_axis,
         },
         input_shape=(max_seq_len,),
         input_dtype="int32",

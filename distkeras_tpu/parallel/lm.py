@@ -1,15 +1,23 @@
-"""Sequence-parallel LM training: dp × sp shard_map step with ring attention.
+"""LM training: dp × sp × tp shard_map step (ring attention + Megatron TP).
 
 No reference counterpart (the reference predates transformers; SURVEY §5
-"long-context: absent") — this is the TPU-native long-context path: batch
-sharded over the ``dp`` mesh axis, sequence sharded over ``sp`` with ring
-attention streaming KV blocks over ICI (``ops/attention.py``), gradients
-pmean'd over both axes, parameters replicated.
+"long-context: absent") — this is the TPU-native long-context path:
+
+- batch sharded over ``dp``;
+- sequence sharded over ``sp`` with ring attention streaming KV blocks over
+  ICI (``ops/attention.py``);
+- heads / FFN sharded over ``tp`` (Megatron column/row split) with the two
+  per-block psums inside the model (``models/transformer.py``);
+- gradients of replicated params arrive via collective adjoints, gradients
+  of tp-sharded params stay local to their shard.
+
+Any of the axes may be absent from the mesh (or size 1): the same step
+builder covers pure-dp, dp×sp, dp×tp and the full 3-D mesh.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -17,21 +25,93 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.models.base import ModelSpec
+from distkeras_tpu.models.base import ModelSpec, build_module
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for k in path:
+        key = getattr(k, "key", None)
+        if key is None:
+            key = getattr(k, "name", None)
+        if key is not None:
+            names.append(str(key))
+    return tuple(names)
+
+
+def _tp_leaf_spec(path, leaf, tp_axis: Optional[str]) -> P:
+    """Megatron placement rule, keyed on the flax param path.
+
+    Matches both the raw param tree and optimizer-state trees (whose paths
+    carry the same ``block_i/<layer>/kernel`` suffix); everything else —
+    layernorms, embeddings, scalar optimizer counters — is replicated.
+    """
+    if tp_axis is None:
+        return P()
+    names = _path_names(path)
+    ndim = len(getattr(leaf, "shape", ()))
+    if "kernel" in names:
+        if "qkv" in names and ndim == 4:
+            return P(None, None, tp_axis, None)
+        if "proj" in names and ndim == 3:
+            return P(tp_axis, None, None)
+        if "up" in names and ndim == 2:
+            return P(None, tp_axis)
+        if "down" in names and ndim == 2:
+            return P(tp_axis, None)
+    return P()
+
+
+def lm_param_specs(params: Any, tp_axis: Optional[str]) -> Any:
+    """PartitionSpec pytree for a TransformerLM param (or optimizer-state,
+    or gradient) tree under Megatron tensor parallelism."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _tp_leaf_spec(path, leaf, tp_axis), params)
+
+
+def lm_opt_specs(optimizer: optax.GradientTransformation, params: Any,
+                 tp_axis: Optional[str]) -> Any:
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _tp_leaf_spec(path, leaf, tp_axis), opt_shapes)
+
+
+def lm_state_shardings(mesh: Mesh, optimizer: optax.GradientTransformation,
+                       params: Any, tp_axis: Optional[str] = None):
+    """(param shardings, opt-state shardings) for placing host state on the
+    mesh — feed to ``jax.device_put`` before the first step."""
+    pspecs = lm_param_specs(params, tp_axis)
+    ospecs = lm_opt_specs(optimizer, params, tp_axis)
+    to_sharding = lambda spec: NamedSharding(mesh, spec)
+    return (jax.tree.map(to_sharding, pspecs, is_leaf=lambda x: isinstance(x, P)),
+            jax.tree.map(to_sharding, ospecs, is_leaf=lambda x: isinstance(x, P)))
 
 
 def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
-                       mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp") -> Callable:
+                       mesh: Mesh, dp_axis: str = "dp", sp_axis: Optional[str] = "sp",
+                       tp_axis: Optional[str] = None) -> Callable:
     """Build a jitted (params, opt_state, tokens, targets) -> (params,
-    opt_state, loss) step. ``spec`` must be a transformer_lm whose config
-    sets ``seq_axis=sp_axis``; tokens/targets are [B, L] with B sharded
-    over dp and L sharded over sp (targets pre-shifted on host).
+    opt_state, loss) step over the mesh.
+
+    ``spec`` is the FULL-model spec (init produces the full param tree);
+    when ``tp_axis`` names a mesh axis, the step internally applies a module
+    configured for the local shard sizes (``tp_size = mesh.shape[tp_axis]``)
+    and expects params placed with ``lm_state_shardings``.  ``sp_axis=None``
+    (or absent from the mesh) disables sequence parallelism; the spec's
+    ``seq_axis`` must agree.
     """
-    if spec.config.get("seq_axis") != sp_axis:
+    sp_active = sp_axis is not None and sp_axis in mesh.shape and mesh.shape[sp_axis] > 1
+    if sp_active and spec.config.get("seq_axis") != sp_axis:
         raise ValueError(
             f"spec.config['seq_axis'] = {spec.config.get('seq_axis')!r} must equal "
             f"sp_axis = {sp_axis!r} or ring attention would not ride this mesh axis")
-    module = spec.build()
+    tp_size = mesh.shape[tp_axis] if (tp_axis is not None and tp_axis in mesh.shape) else 1
+    if tp_axis is not None and tp_axis not in mesh.shape:
+        raise ValueError(f"tp_axis {tp_axis!r} is not a mesh axis of {mesh}")
+    cfg = dict(spec.config)
+    cfg.update(tp_axis=tp_axis if tp_size > 1 else None, tp_size=tp_size)
+    module = build_module(spec.name, cfg)
+    loss_axes = (dp_axis, sp_axis) if sp_active else (dp_axis,)
 
     def local_loss(params, tokens, targets, offset):
         logits = module.apply({"params": params}, tokens, pos_offset=offset)
@@ -41,7 +121,7 @@ def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         # padding, not a real next token.  Global position = offset + local
         # index; only the last sp shard holds the padded column.
         l_local = tokens.shape[1]
-        global_len = l_local * lax.axis_size(sp_axis)
+        global_len = l_local * (lax.axis_size(sp_axis) if sp_active else 1)
         pos = offset + jnp.arange(l_local)
         weights = (pos < global_len - 1).astype(jnp.float32)[None, :]
         wsum = jnp.sum(ce * weights)
@@ -49,44 +129,50 @@ def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         return wsum, wcount
 
     def shard_fn(params, opt_state, tokens, targets):
-        offset = lax.axis_index(sp_axis) * tokens.shape[1]
+        offset = (lax.axis_index(sp_axis) * tokens.shape[1]) if sp_active else 0
 
-        # Differentiate the GLOBAL (pmean'd) loss and use the result as-is.
-        # ``params`` enter the shard as mesh-invariant (P()); their use in
-        # varying computation is an implicit broadcast whose transpose is a
-        # psum, so ``jax.grad`` already returns the cross-shard-summed
-        # gradient of whatever scalar it was given.  Hand it the *global*
-        # loss (psum-normalized masked CE) and the result is exactly dG/dparams —
-        # adding a manual pmean/psum afterwards double-counts by the mesh
-        # size.  This also routes sequence-crossing paths (ring attention
-        # streams KV over sp) correctly via the collective adjoints.
+        # Differentiate the GLOBAL (psum'd) loss and use the result as-is.
+        # Replicated params enter mesh-invariant (P()); their use in varying
+        # computation is an implicit broadcast whose transpose is a psum, so
+        # ``jax.grad`` of the global loss returns the cross-shard-summed
+        # gradient directly — adding a manual pmean/psum would double-count.
+        # tp-sharded params enter tp-varying; their grads stay local to the
+        # shard (Megatron semantics).  The loss itself is tp-INVARIANT —
+        # the in-model psums already merged the partial sums — so it is
+        # reduced over (dp, sp) only.
         def global_loss(p):
             wsum, wcount = local_loss(p, tokens, targets, offset)
-            # wcount depends only on the sp position -> varying over sp but
-            # not dp; psum requires a uniform varying set, so widen it
-            both = (dp_axis, sp_axis)
-            missing = tuple(a for a in both if a not in jax.typeof(wcount).vma)
+            # wsum derives from the (dp/sp-sharded) data so it already varies
+            # over every loss axis; wcount depends only on the sp position and
+            # genuinely lacks dp — widen it for the uniform-vma psum
+            missing = tuple(a for a in loss_axes if a not in jax.typeof(wcount).vma)
             if missing:
                 wcount = lax.pcast(wcount, missing, to="varying")
-            return lax.psum(wsum, both) / lax.psum(wcount, both)
+            return lax.psum(wsum, loss_axes) / lax.psum(wcount, loss_axes)
 
         loss, grads = jax.value_and_grad(global_loss)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    data_spec = P(dp_axis, sp_axis)
+    param_template = jax.eval_shape(lambda: spec.init_params(seed=0))
+    pspecs = lm_param_specs(param_template, tp_axis if tp_size > 1 else None)
+    ospecs = lm_opt_specs(optimizer, param_template, tp_axis if tp_size > 1 else None)
+    data_spec = P(dp_axis, sp_axis) if sp_active else P(dp_axis)
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(), data_spec, data_spec),
-        out_specs=(P(), P(), P()),
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
-def lm_data_shardings(mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"):
-    return NamedSharding(mesh, P(dp_axis, sp_axis))
+def lm_data_shardings(mesh: Mesh, dp_axis: str = "dp", sp_axis: Optional[str] = "sp"):
+    # same activation predicate as make_lm_train_step (size-1 sp is inactive)
+    if sp_axis is not None and sp_axis in mesh.shape and mesh.shape[sp_axis] > 1:
+        return NamedSharding(mesh, P(dp_axis, sp_axis))
+    return NamedSharding(mesh, P(dp_axis))
 
 
 def shift_targets(tokens) -> Any:
