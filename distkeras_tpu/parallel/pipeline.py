@@ -1,0 +1,232 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` axis.
+
+No reference counterpart (the reference is data-parallel only, SURVEY.md
+§2.13) — TPU-native headroom.  The design leans on two XLA facts:
+
+1. A pipeline is just a rotation: each rank applies its resident stage
+   (``num_layers / pp`` transformer blocks) to its current buffer, then
+   ``lax.ppermute``s the activations one hop to the next rank.  Rank 0
+   feeds a fresh microbatch each tick; the last rank collects finished
+   microbatches.  ``M + pp - 1`` ticks drain ``M`` microbatches.
+2. The backward schedule is NOT hand-written: differentiating through the
+   tick scan reverses every ppermute (collective adjoints), which IS the
+   backward pipeline.  ``jax.checkpoint`` around the stage keeps the
+   per-tick residuals O(microbatch), the standard remat trade.
+
+Layout: block params are stacked to [num_layers, ...] and sharded over pp
+on the leading axis (each rank holds its stage's slab); embedding/unembed/
+final-norm params are replicated — only rank 0's embedding output enters
+the pipeline, so its gradient routes exclusively through rank 0's path.
+
+Composes with data parallelism over a (dp, pp) mesh; tensor/sequence axes
+compose at the block level and are left out of the v1 pipeline step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.base import ModelSpec, build_module
+from distkeras_tpu.models.transformer import TransformerBlock
+
+
+def split_block_params(params: Dict[str, Any]) -> Tuple[Dict[str, Any], Any]:
+    """Full TransformerLM params -> (outer params, blocks stacked on axis 0).
+
+    ``outer`` keeps the embedding / positional / final-norm leaves under
+    their original names; ``blocks`` stacks ``block_0..block_{n-1}`` (all
+    structurally identical) into one pytree with a leading layer axis.
+    """
+    names = sorted((k for k in params if k.startswith("block_")),
+                   key=lambda k: int(k.split("_")[1]))
+    if not names:
+        raise ValueError("params contain no block_i subtrees; not a TransformerLM tree")
+    blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *[params[k] for k in names])
+    outer = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return outer, blocks
+
+
+def merge_block_params(outer: Dict[str, Any], blocks: Any) -> Dict[str, Any]:
+    """Inverse of ``split_block_params`` (for checkpointing / serialization)."""
+    num_layers = jax.tree.leaves(blocks)[0].shape[0]
+    params = dict(outer)
+    for i in range(num_layers):
+        params[f"block_{i}"] = jax.tree.map(lambda a, i=i: a[i], blocks)
+    return params
+
+
+def pp_param_specs(outer: Dict[str, Any], blocks: Any, pp_axis: str):
+    outer_specs = jax.tree.map(lambda _: P(), outer)
+    block_specs = jax.tree.map(lambda _: P(pp_axis), blocks)
+    return outer_specs, block_specs
+
+
+def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                       mesh: Mesh, num_microbatches: int,
+                       dp_axis: str = "dp", pp_axis: str = "pp") -> Callable:
+    """Build a jitted ((outer, blocks), opt_state, tokens, targets) ->
+    ((outer, blocks), opt_state, loss) pipeline-parallel training step.
+
+    ``tokens``/``targets`` are [B, L] with B sharded over dp (and B a
+    multiple of ``num_microbatches`` per dp shard); block params must be
+    placed with ``pp_state_shardings``.
+    """
+    pp = mesh.shape[pp_axis]
+    num_layers = spec.config["num_layers"]
+    if num_layers % pp:
+        raise ValueError(f"num_layers {num_layers} not divisible by pp {pp}")
+    layers_per_stage = num_layers // pp
+    cfg = spec.config
+    block = TransformerBlock(
+        model_dim=cfg["model_dim"], num_heads=cfg["num_heads"],
+        mlp_ratio=cfg.get("mlp_ratio", 4), seq_axis=None,
+        attn_impl=cfg.get("attn_impl"))
+    module = build_module(spec.name, dict(cfg, seq_axis=None))
+
+    @jax.checkpoint
+    def stage_apply(stage_params, x):
+        """Apply this rank's ``layers_per_stage`` blocks (scan over the slab)."""
+
+        def one(x, layer_params):
+            return block.apply({"params": layer_params}, x), None
+
+        x, _ = lax.scan(one, x, stage_params)
+        return x
+
+    def shard_fn(params, opt_state, tokens, targets):
+        outer, blocks = params
+        my = lax.axis_index(pp_axis)
+
+        def global_loss(p):
+            outer, blocks = p
+            # stage slab arrives as [layers_per_stage, ...] (leading pp axis
+            # stripped by shard_map); embedding is computed identically on
+            # every rank but only rank 0's copy enters the pipeline
+            b, l = tokens.shape
+            mb = b // num_microbatches
+            toks_mb = tokens.reshape(num_microbatches, mb, l)
+
+            x_emb = module.apply({"params": outer}, toks_mb.reshape(b, l),
+                                 method=_embed_only)
+            x_emb = x_emb.reshape(num_microbatches, mb, l, -1)
+            x_emb = lax.pcast(x_emb, (pp_axis,), to="varying") \
+                if pp_axis not in jax.typeof(x_emb).vma else x_emb
+
+            e = x_emb.shape[-1]
+            ticks = num_microbatches + pp - 1
+            buf0 = jnp.zeros((mb, l, e), x_emb.dtype)
+            outs0 = jnp.zeros_like(x_emb)
+            buf0, outs0 = (lax.pcast(z, (pp_axis,), to="varying") for z in (buf0, outs0))
+
+            def tick(carry, t):
+                buf, outs = carry
+                feed = lax.dynamic_index_in_dim(
+                    x_emb, jnp.clip(t, 0, num_microbatches - 1), 0, keepdims=False)
+                x_in = jnp.where(my == 0, feed, buf)
+                # idle ranks/ticks compute on garbage; results are never
+                # collected (GPipe bubble) — predication would not save
+                # wall-clock on a SPMD schedule
+                y = stage_apply(blocks, x_in)
+                done_idx = t - (pp - 1)
+                valid = jnp.logical_and(my == pp - 1, done_idx >= 0)
+                new_outs = lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(done_idx, 0, num_microbatches - 1), 0)
+                outs = jnp.where(valid, new_outs, outs)
+                perm = [(i, (i + 1) % pp) for i in range(pp)]
+                buf = lax.ppermute(y, pp_axis, perm)
+                return (buf, outs), None
+
+            (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
+            # finished activations live on the last rank only; mask + psum
+            # replicates them (making the rest of the loss pp-invariant)
+            outs = lax.psum(jnp.where(my == pp - 1, outs, 0.0), pp_axis)
+
+            logits = module.apply({"params": outer}, outs.reshape(b, l, e),
+                                  method=_head_only)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), targets.astype(jnp.int32))
+            wsum = jnp.sum(ce[:, :-1])
+            wcount = jnp.float32(b * (l - 1))
+            wcount = lax.pcast(wcount, (dp_axis,), to="varying")
+            return lax.psum(wsum, (dp_axis,)) / lax.psum(wcount, (dp_axis,))
+
+        loss, grads = jax.value_and_grad(global_loss)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    outer_t, blocks_t = jax.eval_shape(
+        lambda: split_block_params(spec.init_params(seed=0)))
+    pspecs = pp_param_specs(outer_t, blocks_t, pp_axis)
+    ospecs = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _opt_leaf_spec(path, pp_axis),
+        jax.eval_shape(optimizer.init, (outer_t, blocks_t)))
+    data_spec = P(dp_axis)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def _opt_leaf_spec(path, pp_axis: str) -> P:
+    """Optimizer-state leaves mirroring the (outer, blocks) params tuple.
+
+    Optax states nest that tuple under namedtuple/tuple wrappers whose keys
+    are also SequenceKeys, so walk from the leaf upward: the innermost
+    SequenceKey (the params-tuple position, since everything below it is
+    the flax dict tree) decides — index 1 is the pp-sharded block slab.
+    Pure-scalar leaves (step counters) sit directly under state tuples and
+    resolve to index 0 -> replicated, which is correct for them too.
+    """
+    for k in reversed(path):
+        idx = getattr(k, "idx", None)
+        if idx == 1:
+            return P(pp_axis)
+        if idx is not None:
+            return P()
+    return P()
+
+
+def _embed_only(model, tokens, pos_offset: int = 0):
+    """TransformerLM method: token + positional embedding only."""
+    import flax.linen as nn
+
+    embed = nn.Embed(model.vocab_size, model.model_dim, dtype=model.compute_dtype,
+                     name="embed")
+    pos_table = model.param("pos_embed", nn.initializers.normal(0.02),
+                            (model.max_seq_len, model.model_dim))
+    x = embed(tokens)
+    pos = jnp.arange(tokens.shape[1]) + pos_offset
+    return x + pos_table[pos].astype(model.compute_dtype)
+
+
+def _head_only(model, x):
+    """TransformerLM method: final norm + tied unembedding."""
+    import flax.linen as nn
+
+    embed = nn.Embed(model.vocab_size, model.model_dim, dtype=model.compute_dtype,
+                     name="embed")
+    x = nn.LayerNorm(dtype=model.compute_dtype)(x)
+    return embed.attend(x.astype(jnp.float32))
+
+
+def pp_state_shardings(mesh: Mesh, optimizer: optax.GradientTransformation,
+                       outer: Dict[str, Any], blocks: Any,
+                       pp_axis: str = "pp"):
+    pspecs = pp_param_specs(outer, blocks, pp_axis)
+    ospecs = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _opt_leaf_spec(path, pp_axis),
+        jax.eval_shape(optimizer.init, (outer, blocks)))
+    to_sh = lambda s: NamedSharding(mesh, s)
+    return (jax.tree.map(to_sh, pspecs, is_leaf=lambda x: isinstance(x, P)),
+            jax.tree.map(to_sh, ospecs, is_leaf=lambda x: isinstance(x, P)))
