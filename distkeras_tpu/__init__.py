@@ -24,28 +24,45 @@ Public API mirrors the reference's trainer surface:
 
 __version__ = "0.1.0"
 
-from distkeras_tpu.trainers import (  # noqa: F401
-    Trainer,
-    SingleTrainer,
-    DistributedTrainer,
-    ADAG,
-    DOWNPOUR,
-    AEASGD,
-    EAMSGD,
-    DynSGD,
-    AveragingTrainer,
-    EnsembleTrainer,
-)
-from distkeras_tpu.runtime.async_trainer import (  # noqa: F401
-    AsyncADAG,
-    AsyncAEASGD,
-    AsyncDistributedTrainer,
-    AsyncDOWNPOUR,
-    AsyncDynSGD,
-    AsyncEAMSGD,
-)
-from distkeras_tpu.checkpoint import Checkpointer  # noqa: F401
-from distkeras_tpu.data.dataset import Dataset  # noqa: F401
-from distkeras_tpu.models.base import Model, ModelSpec  # noqa: F401
-from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
-from distkeras_tpu.evaluators import AccuracyEvaluator  # noqa: F401
+# Lazy re-exports (PEP 562).  Keeps `import distkeras_tpu` (and importing
+# leaf submodules like distkeras_tpu.platform) free of jax/flax/optax
+# import-time work, so platform pinning can run before any backend touch.
+_EXPORTS = {
+    "Trainer": "distkeras_tpu.trainers",
+    "SingleTrainer": "distkeras_tpu.trainers",
+    "DistributedTrainer": "distkeras_tpu.trainers",
+    "ADAG": "distkeras_tpu.trainers",
+    "DOWNPOUR": "distkeras_tpu.trainers",
+    "AEASGD": "distkeras_tpu.trainers",
+    "EAMSGD": "distkeras_tpu.trainers",
+    "DynSGD": "distkeras_tpu.trainers",
+    "AveragingTrainer": "distkeras_tpu.trainers",
+    "EnsembleTrainer": "distkeras_tpu.trainers",
+    "AsyncDistributedTrainer": "distkeras_tpu.runtime.async_trainer",
+    "AsyncADAG": "distkeras_tpu.runtime.async_trainer",
+    "AsyncDOWNPOUR": "distkeras_tpu.runtime.async_trainer",
+    "AsyncAEASGD": "distkeras_tpu.runtime.async_trainer",
+    "AsyncEAMSGD": "distkeras_tpu.runtime.async_trainer",
+    "AsyncDynSGD": "distkeras_tpu.runtime.async_trainer",
+    "Checkpointer": "distkeras_tpu.checkpoint",
+    "Dataset": "distkeras_tpu.data.dataset",
+    "Model": "distkeras_tpu.models.base",
+    "ModelSpec": "distkeras_tpu.models.base",
+    "ModelPredictor": "distkeras_tpu.predictors",
+    "AccuracyEvaluator": "distkeras_tpu.evaluators",
+    "pin_cpu_devices": "distkeras_tpu.platform",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,47 @@
+"""Platform pinning — a LEAF module (imports nothing from this package).
+
+Kept import-light on purpose: callers (tests/conftest.py, the driver's
+multichip dry run, examples) must be able to pin the CPU platform before
+any other module gets a chance to touch a JAX backend.  The package
+``__init__`` is lazy (PEP 562) so ``from distkeras_tpu.platform import
+pin_cpu_devices`` executes only this file.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_devices(n: int) -> None:
+    """Pin this process to an ``n``-device virtual CPU platform.
+
+    The one shared copy of the CPU-simulation recipe (tests, examples, and
+    the driver's multichip dry run all use it).  Two traps it handles:
+
+    - The axon TPU sitecustomize forces ``jax_platforms='axon,cpu'`` via
+      jax.config at interpreter start, so the ``JAX_PLATFORMS`` env var is
+      ignored — only ``jax.config.update`` wins.  Touching the default
+      backend first can hang on a held TPU, so CPU must be pinned before
+      the first ``jax.devices()`` call.
+    - ``--xla_force_host_platform_device_count`` is read once at CPU client
+      creation; if a backend already exists (wrong platform or too few
+      devices) the only fix is ``clear_backends()`` + ``jax_num_cpu_devices``
+      (which takes precedence over the XLA flag).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if len(devs) < n or devs[0].platform != "cpu":
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_num_cpu_devices", n)
+        devs = jax.devices()
+    if len(devs) < n or devs[0].platform != "cpu":
+        raise RuntimeError(f"could not materialize {n} CPU devices; have {devs}")

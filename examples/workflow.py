@@ -13,7 +13,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 
@@ -34,10 +33,9 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + f" --xla_force_host_platform_device_count={args.cpu}").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.platform import pin_cpu_devices
+
+        pin_cpu_devices(args.cpu)
     import jax
     import numpy as np
 

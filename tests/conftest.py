@@ -6,19 +6,9 @@ so every mesh/collective path runs in CI without TPU hardware.  Must be
 set before jax initializes — hence here, at conftest import time.
 """
 
-import os
+from distkeras_tpu.platform import pin_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-# The axon TPU plugin's sitecustomize forces jax_platforms="axon,cpu" at
-# interpreter start, which overrides the env var — override it back before
-# any backend initializes.
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
