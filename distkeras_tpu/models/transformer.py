@@ -101,16 +101,18 @@ class TransformerLM(nn.Module):
     attn_impl: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
-    @nn.compact
-    def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
-        b, l = tokens.shape
-        embed = nn.Embed(self.vocab_size, self.model_dim, dtype=self.compute_dtype, name="embed")
-        pos_table = self.param("pos_embed", nn.initializers.normal(0.02), (self.max_seq_len, self.model_dim))
-        x = embed(tokens)
-        pos = jnp.arange(l) + pos_offset
-        x = x + pos_table[pos].astype(self.compute_dtype)
-        for i in range(self.num_layers):
-            x = TransformerBlock(
+    def setup(self):
+        # attribute names ARE the param-tree keys: "embed", "pos_embed",
+        # "block_0..N-1" (list attr `block` -> `block_{i}`), "final_norm".
+        # parallel/pipeline.py splits on the block_ prefix and shards the
+        # rest as replicated "outer" leaves.  NOTE: "final_norm" replaces
+        # the compact-era auto-name "LayerNorm_0" — an intentional
+        # serialized-format break (no published checkpoints predate it).
+        self.embed = nn.Embed(self.vocab_size, self.model_dim, dtype=self.compute_dtype)
+        self.pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (self.max_seq_len, self.model_dim))
+        self.block = [
+            TransformerBlock(
                 model_dim=self.model_dim,
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
@@ -119,11 +121,32 @@ class TransformerLM(nn.Module):
                 tp_size=self.tp_size,
                 attn_impl=self.attn_impl,
                 compute_dtype=self.compute_dtype,
-                name=f"block_{i}",
-            )(x)
-        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        logits = embed.attend(x.astype(jnp.float32))
-        return logits
+            )
+            for _ in range(self.num_layers)
+        ]
+        self.final_norm = nn.LayerNorm(dtype=self.compute_dtype)
+
+    def embed_tokens(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        """Token + positional embedding: [B, L] int32 -> [B, L, E].
+
+        A real bound method (not a free function passed to
+        ``apply(method=...)``) so the pipeline-parallel step can run the
+        embedding alone against the same param leaves as ``__call__``.
+        """
+        x = self.embed(tokens)
+        pos = jnp.arange(tokens.shape[1]) + pos_offset
+        return x + self.pos_embed[pos].astype(self.compute_dtype)
+
+    def head(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Final norm + tied unembedding: [B, L, E] -> [B, L, vocab] logits."""
+        x = self.final_norm(x)
+        return self.embed.attend(x.astype(jnp.float32))
+
+    def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        x = self.embed_tokens(tokens, pos_offset)
+        for blk in self.block:
+            x = blk(x)
+        return self.head(x)
 
 
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,

@@ -84,10 +84,11 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         raise ValueError(f"num_layers {num_layers} not divisible by pp {pp}")
     layers_per_stage = num_layers // pp
     cfg = spec.config
+    cdtype = cfg.get("compute_dtype", jnp.bfloat16)
     block = TransformerBlock(
         model_dim=cfg["model_dim"], num_heads=cfg["num_heads"],
         mlp_ratio=cfg.get("mlp_ratio", 4), seq_axis=None,
-        attn_impl=cfg.get("attn_impl"))
+        attn_impl=cfg.get("attn_impl"), compute_dtype=cdtype)
     module = build_module(spec.name, dict(cfg, seq_axis=None))
 
     @jax.checkpoint
@@ -113,17 +114,25 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
             mb = b // num_microbatches
             toks_mb = tokens.reshape(num_microbatches, mb, l)
 
+            # Embed/head run outside the pipeline via TransformerLM's own
+            # bound methods, so they share one source of truth (and the
+            # exact param leaves) with the single-device __call__ path.
+            # The block params are absent from `outer`, which is fine:
+            # embed_tokens/head never touch them.
             x_emb = module.apply({"params": outer}, toks_mb.reshape(b, l),
-                                 method=_embed_only)
+                                 method="embed_tokens")
             x_emb = x_emb.reshape(num_microbatches, mb, l, -1)
-            x_emb = lax.pcast(x_emb, (pp_axis,), to="varying") \
-                if pp_axis not in jax.typeof(x_emb).vma else x_emb
 
+            def vary(z):
+                missing = tuple(a for a in (dp_axis, pp_axis)
+                                if a not in jax.typeof(z).vma)
+                return lax.pcast(z, missing, to="varying") if missing else z
+
+            x_emb = vary(x_emb)
             e = x_emb.shape[-1]
             ticks = num_microbatches + pp - 1
-            buf0 = jnp.zeros((mb, l, e), x_emb.dtype)
-            outs0 = jnp.zeros_like(x_emb)
-            buf0, outs0 = (lax.pcast(z, (pp_axis,), to="varying") for z in (buf0, outs0))
+            buf0 = vary(jnp.zeros((mb, l, e), x_emb.dtype))
+            outs0 = vary(jnp.zeros_like(x_emb))
 
             def tick(carry, t):
                 buf, outs = carry
@@ -149,7 +158,7 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
             outs = lax.psum(jnp.where(my == pp - 1, outs, 0.0), pp_axis)
 
             logits = module.apply({"params": outer}, outs.reshape(b, l, e),
-                                  method=_head_only)
+                                  method="head")
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), targets.astype(jnp.int32))
             wsum = jnp.sum(ce[:, :-1])
@@ -195,29 +204,6 @@ def _opt_leaf_spec(path, pp_axis: str) -> P:
         if idx is not None:
             return P()
     return P()
-
-
-def _embed_only(model, tokens, pos_offset: int = 0):
-    """TransformerLM method: token + positional embedding only."""
-    import flax.linen as nn
-
-    embed = nn.Embed(model.vocab_size, model.model_dim, dtype=model.compute_dtype,
-                     name="embed")
-    pos_table = model.param("pos_embed", nn.initializers.normal(0.02),
-                            (model.max_seq_len, model.model_dim))
-    x = embed(tokens)
-    pos = jnp.arange(tokens.shape[1]) + pos_offset
-    return x + pos_table[pos].astype(model.compute_dtype)
-
-
-def _head_only(model, x):
-    """TransformerLM method: final norm + tied unembedding."""
-    import flax.linen as nn
-
-    embed = nn.Embed(model.vocab_size, model.model_dim, dtype=model.compute_dtype,
-                     name="embed")
-    x = nn.LayerNorm(dtype=model.compute_dtype)(x)
-    return embed.attend(x.astype(jnp.float32))
 
 
 def pp_state_shardings(mesh: Mesh, optimizer: optax.GradientTransformation,
