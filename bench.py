@@ -1,16 +1,24 @@
-"""Headline benchmark: MNIST-CNN training samples/sec/chip (BASELINE.md §1).
+"""Benchmark harness. Prints exactly ONE JSON line on stdout, always.
 
-Prints exactly one JSON line:
-    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+Headline metric (BASELINE.md §1): MNIST-CNN training samples/sec/chip —
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
-Runs on whatever accelerator is visible (the driver provides one real TPU
-chip).  Data content doesn't affect throughput, so MNIST-shaped synthetic
-tensors stand in for the real dataset in offline environments.
+Extra keys on the same object (diagnostics + secondary benches):
+    platform      — backend actually used ("tpu" or "cpu" fallback)
+    init_error    — TPU init failure that forced the CPU fallback, if any
+    lm            — TransformerLM train-step bench (tokens/sec + MFU) at
+                    2k and 8k tokens, flash attention, TPU only
+    attn          — flash-vs-dense attention kernel microbench (fwd+bwd
+                    ms/step and speedup) at 2k and 8k tokens, TPU only
+    error         — fatal failure note; value stays 0.0 but the line still
+                    parses (round-1 failure mode was rc=1 with NO output)
 
-``vs_baseline``: the reference publishes no benchmark numbers
-(BASELINE.md — "none recoverable"; upstream dist-keras ships no metric
-table), so the ratio is against the recorded best of THIS repo
-(bench_baseline.json, committed once established).  First run: 1.0.
+``vs_baseline``: the reference publishes no benchmark numbers (BASELINE.md
+— "none recoverable"), so the ratio is against the recorded best of THIS
+repo (bench_baseline.json).  First run: 1.0.
+
+Data content doesn't affect throughput, so MNIST-shaped synthetic tensors
+stand in for the real dataset in offline environments.
 """
 
 from __future__ import annotations
@@ -18,9 +26,56 @@ from __future__ import annotations
 import json
 import os
 import time
+import traceback
+
+# bf16 peak FLOPs/sec by device_kind prefix (public spec sheets)
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
 
 
-def main() -> None:
+def _peak_flops(device_kind: str):
+    for prefix, peak in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if device_kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _init_backend(retries: int = 3, wait_s: float = 10.0):
+    """Bring up whatever accelerator is visible; never raise.
+
+    Round-1 failure mode (VERDICT weak #2): one transient 'Unable to
+    initialize backend axon' aborted the whole bench with rc=1 and zero
+    output.  Retry the default platform; if it never comes up, pin the CPU
+    platform so the bench still emits a comparable (if slow) number.
+    Returns (platform, init_error_or_None).
+    """
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            jax.devices()
+            return jax.default_backend(), None
+        except RuntimeError as e:  # backend init failure; not a bug in us
+            last = e
+            if attempt + 1 < retries:
+                time.sleep(wait_s)
+    from distkeras_tpu.platform import pin_cpu_devices
+
+    pin_cpu_devices(1)
+    return jax.default_backend(), f"{type(last).__name__}: {last}"
+
+
+def _bench_mnist_cnn(batch_size: int = 256, num_batches: int = 200, reps: int = 3):
+    """Headline number: MNIST-CNN scan-epoch training throughput."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -31,8 +86,6 @@ def main() -> None:
     from distkeras_tpu.ops.losses import get_loss
     from distkeras_tpu.parallel.engine import scan_epoch_fn
 
-    batch_size = 256
-    num_batches = 200
     spec = mnist_cnn_spec()
     model = Model.init(spec, seed=0)
     optimizer = optax.sgd(0.01, momentum=0.9)
@@ -53,31 +106,197 @@ def main() -> None:
     np.asarray(losses)
 
     t0 = time.perf_counter()
-    reps = 3
     for _ in range(reps):
         params, opt_state, losses = epoch_fn(params, opt_state, xs_d, ys_d)
         np.asarray(losses)
     dt = time.perf_counter() - t0
 
     samples = reps * num_batches * batch_size
-    sps = samples / dt
-    n_chips = jax.device_count()
-    sps_per_chip = sps / n_chips
+    return samples / dt / jax.device_count()
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
-    vs = 1.0
-    if os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            base = json.load(f).get("value")
-        if base:
-            vs = sps_per_chip / base
 
-    print(json.dumps({
+def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int = 8,
+              num_layers: int = 8, vocab: int = 8192, steps: int = 10):
+    """TransformerLM fwd+bwd train step: tokens/sec + MFU (flash attention).
+
+    MFU counts the matmul FLOPs the model *requires*: 6·T·P_matmul for the
+    dense projections + unembed (fwd 2·T·P, bwd 2x) plus the causal
+    attention term 6·n_layers·B·L²·E (4·B·L²·E fwd halved by causality,
+    times 3 for fwd+bwd) — the standard PaLM-style accounting.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.parallel.lm import shift_targets
+
+    spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim, num_heads=num_heads,
+                         num_layers=num_layers, max_seq_len=seq_len)
+    model = Model.init(spec, seed=0)
+    apply_fn = spec.apply_fn()
+    opt = optax.sgd(0.01)
+
+    def loss_fn(params, tok, tgt):
+        logits = apply_fn(params, tok)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgt)
+        return ce[:, :-1].mean()
+
+    # the step loop lives INSIDE the compiled program: per-dispatch host
+    # round trips (~100ms on the relayed axon platform) would otherwise
+    # dominate and the bench would measure RPC latency, not the chip
+    @jax.jit
+    def run(params, opt_state, tok, tgt):
+        def body(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, tok, tgt)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=steps)
+        return params, opt_state, losses
+
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)), dtype=jnp.int32)
+    tgt = jnp.asarray(shift_targets(np.asarray(tok)))
+    params = jax.tree.map(jnp.array, model.params)
+    opt_state = opt.init(params)
+
+    params, opt_state, losses = run(params, opt_state, tok, tgt)  # compile
+    np.asarray(losses)
+    t0 = time.perf_counter()
+    params, opt_state, losses = run(params, opt_state, tok, tgt)
+    np.asarray(losses)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq_len
+    e = model_dim
+    p_matmul = 12 * e * e * num_layers + e * vocab
+    flops_per_step = (6 * tokens_per_step * p_matmul
+                      + 6 * num_layers * batch * seq_len * seq_len * e)
+    sec_per_step = dt / steps
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    return {
+        "seq_len": seq_len,
+        "batch": batch,
+        "tokens_per_sec": round(tokens_per_step / sec_per_step, 1),
+        "ms_per_step": round(sec_per_step * 1e3, 2),
+        "mfu": round(flops_per_step / sec_per_step / peak, 4) if peak else None,
+    }
+
+
+def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int = 64,
+                steps: int = 5):
+    """Kernel microbench: Pallas flash vs XLA dense attention, fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu.ops.attention import dense_attention
+    from distkeras_tpu.ops.flash_attention import flash_attention
+
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    shape = (batch, seq_len, heads, head_dim)
+    q, k, v = (jnp.asarray(rng.normal(size=shape) * 0.1, dtype=jnp.bfloat16)
+               for _ in range(3))
+
+    def timed(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+        # loop inside the program (see _bench_lm); feeding each step's grad
+        # back into q keeps the body loop-variant so XLA cannot hoist it
+        @jax.jit
+        def run(q, k, v):
+            def body(q, _):
+                gq, gk, gv = grad_fn(q, k, v)
+                # all three grads must stay live or XLA DCEs the dv matmul
+                # out of the dense backward (the fused flash VJP can't be
+                # partially eliminated, which would skew the comparison)
+                return q + 1e-6 * gq, (jnp.sum(gk) + jnp.sum(gv)).astype(jnp.float32)
+
+            q, sums = lax.scan(body, q, None, length=steps)
+            return sums
+
+        np.asarray(run(q, k, v))  # compile
+        t0 = time.perf_counter()
+        np.asarray(run(q, k, v))
+        return (time.perf_counter() - t0) / steps * 1e3  # ms
+
+    flash_ms = timed(flash_attention)
+    dense_ms = timed(dense_attention)
+    return {
+        "seq_len": seq_len,
+        "flash_ms": round(flash_ms, 2),
+        "dense_ms": round(dense_ms, 2),
+        "flash_speedup": round(dense_ms / flash_ms, 2),
+    }
+
+
+def main() -> None:
+    out = {
         "metric": "mnist_cnn_train_samples_per_sec_per_chip",
-        "value": round(sps_per_chip, 1),
+        "value": 0.0,
         "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 3),
-    }))
+        "vs_baseline": 0.0,
+    }
+    try:
+        platform, init_error = _init_backend()
+        out["platform"] = platform
+        if init_error:
+            out["init_error"] = init_error
+
+        sps_per_chip = _bench_mnist_cnn()
+        out["value"] = round(sps_per_chip, 1)
+
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+        vs = 1.0
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+            base = baseline.get("value")
+            if base and baseline.get("platform", "tpu") != platform:
+                # CPU-fallback throughput vs a TPU baseline is meaningless;
+                # flag it instead of reporting a catastrophic-looking ratio
+                # (round(3) would also collapse it onto the 0.0 sentinel)
+                out["vs_baseline_note"] = (
+                    f"baseline recorded on {baseline.get('platform', 'tpu')}; "
+                    f"this run on {platform} — ratio not comparable")
+            if base:
+                vs = sps_per_chip / base
+        # 6 digits: a real-but-tiny ratio must stay distinguishable from the
+        # 0.0 fatal-error sentinel
+        out["vs_baseline"] = round(vs, 6)
+
+        if platform == "tpu":
+            # secondary benches are TPU-only (flash is a Mosaic kernel) and
+            # individually fallible — a failure is recorded, not fatal
+            lm, attn = [], []
+            for seq, batch in ((2048, 8), (8192, 2)):
+                try:
+                    lm.append(_bench_lm(seq, batch))
+                except Exception as e:
+                    lm.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
+            for seq in (2048, 8192):
+                try:
+                    attn.append(_bench_attn(seq))
+                except Exception as e:
+                    attn.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
+            out["lm"] = lm
+            out["attn"] = attn
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        out["traceback_tail"] = traceback.format_exc().strip().splitlines()[-3:]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,53 @@
+"""bench.py contract: exactly one parseable JSON line on stdout, always.
+
+Round-1 failure mode (VERDICT weak #2): a transient TPU-init error aborted
+the bench with rc=1 and zero output, leaving the round with no perf
+evidence.  The contract now is: main() never raises, and always prints one
+JSON object with the headline metric keys — populated on success, zeroed
+with an ``error`` note on failure.
+"""
+
+import json
+
+import bench
+
+
+def _parse_single_json_line(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"expected exactly one stdout line, got {out}"
+    return json.loads(out[0])
+
+
+def test_main_emits_metric_line(capsys, monkeypatch):
+    monkeypatch.setattr(bench, "_bench_mnist_cnn",
+                        lambda **kw: 123.4)
+    bench.main()
+    rec = _parse_single_json_line(capsys)
+    assert rec["metric"] == "mnist_cnn_train_samples_per_sec_per_chip"
+    assert rec["value"] == 123.4
+    assert rec["unit"] == "samples/sec/chip"
+    assert isinstance(rec["vs_baseline"], float)
+    assert rec["platform"] == "cpu"  # conftest pins the CPU platform
+
+
+def test_main_emits_diagnostic_line_on_failure(capsys, monkeypatch):
+    def boom(**kw):
+        raise RuntimeError("synthetic backend meltdown")
+
+    monkeypatch.setattr(bench, "_bench_mnist_cnn", boom)
+    bench.main()
+    rec = _parse_single_json_line(capsys)
+    assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
+    assert "synthetic backend meltdown" in rec["error"]
+    assert rec["metric"] == "mnist_cnn_train_samples_per_sec_per_chip"
+
+
+def test_mnist_bench_runs_on_cpu():
+    sps = bench._bench_mnist_cnn(batch_size=8, num_batches=2, reps=1)
+    assert sps > 0
+
+
+def test_peak_flops_lookup():
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v5p chip") == 459e12
+    assert bench._peak_flops("Quantum Abacus 9000") is None
