@@ -266,15 +266,12 @@ def main() -> None:
             base = baseline.get("value")
             if base and baseline.get("platform", "tpu") != platform:
                 # CPU-fallback throughput vs a TPU baseline is meaningless;
-                # flag it instead of reporting a catastrophic-looking ratio
-                # (round(3) would also collapse it onto the 0.0 sentinel)
+                # skip the ratio (keep 1.0) and flag why
                 out["vs_baseline_note"] = (
                     f"baseline recorded on {baseline.get('platform', 'tpu')}; "
-                    f"this run on {platform} — ratio not comparable")
-            if base:
+                    f"this run on {platform} — ratio not computed")
+            elif base:
                 vs = sps_per_chip / base
-        # 6 digits: a real-but-tiny ratio must stay distinguishable from the
-        # 0.0 fatal-error sentinel
         out["vs_baseline"] = round(vs, 6)
 
         if platform == "tpu":
@@ -294,6 +291,8 @@ def main() -> None:
             out["lm"] = lm
             out["attn"] = attn
     except Exception as e:
+        out["value"] = 0.0  # contract: error lines carry the zero sentinel,
+        out["vs_baseline"] = 0.0  # even if a sub-step already set a value
         out["error"] = f"{type(e).__name__}: {e}"
         out["traceback_tail"] = traceback.format_exc().strip().splitlines()[-3:]
     print(json.dumps(out))

@@ -44,6 +44,8 @@ _EXPORTS = {
     "AsyncAEASGD": "distkeras_tpu.runtime.async_trainer",
     "AsyncEAMSGD": "distkeras_tpu.runtime.async_trainer",
     "AsyncDynSGD": "distkeras_tpu.runtime.async_trainer",
+    "Punchcard": "distkeras_tpu.runtime.job_deployment",
+    "Job": "distkeras_tpu.runtime.job_deployment",
     "Checkpointer": "distkeras_tpu.checkpoint",
     "Dataset": "distkeras_tpu.data.dataset",
     "Model": "distkeras_tpu.models.base",

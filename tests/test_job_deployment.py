@@ -1,0 +1,163 @@
+"""Punchcard job-deployment round trips (reference: distkeras/job_deployment.py).
+
+The reference layer was submit-a-job-with-a-secret to a service on the
+cluster head and get a trained model back (SURVEY.md §2.18).  These tests
+run the daemon in-process on localhost and drive the full client surface:
+submit/status/wait/fetch/run, inline and npz-path datasets, auth failure,
+queue FIFO, and path-traversal containment.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.base import ModelSpec
+from distkeras_tpu.runtime.job_deployment import (
+    DONE, FAILED, Job, Punchcard, list_jobs, shutdown)
+
+SECRET = "test-secret"
+
+
+def _toy_data(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=3.0, size=(classes, dim))
+    labels = rng.integers(0, classes, size=n)
+    feats = centers[labels] + rng.normal(scale=0.5, size=(n, dim))
+    onehot = np.eye(classes, dtype=np.float32)[labels]
+    return feats.astype(np.float32), onehot, labels
+
+
+def _spec(dim=8, classes=4):
+    return ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": classes},
+                     input_shape=(dim,))
+
+
+@pytest.fixture()
+def punchcard(tmp_path):
+    pc = Punchcard(secret=SECRET, data_root=str(tmp_path)).start()
+    yield pc
+    pc.stop()
+
+
+def test_submit_run_fetch_roundtrip(punchcard):
+    feats, onehot, labels = _toy_data()
+    ds = Dataset({"features": feats, "label": onehot})
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="roundtrip",
+              model=_spec(), trainer="single",
+              trainer_kwargs={"num_epoch": 20, "batch_size": 32,
+                              "learning_rate": 0.1},
+              data=ds)
+    model = job.run(timeout=120)
+    st = job.status()
+    assert st["state"] == DONE
+    assert st["training_time"] > 0
+    assert len(st["history"]) > 0 and st["history"][-1] < st["history"][0]
+    preds = model.predict(feats).argmax(axis=-1)
+    assert (preds == labels).mean() > 0.8
+
+
+def test_distributed_trainer_job(punchcard):
+    feats, onehot, _ = _toy_data(n=512)
+    ds = Dataset({"features": feats, "label": onehot})
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="adag-job",
+              model=_spec(), trainer="adag",
+              trainer_kwargs={"num_epoch": 3, "batch_size": 16,
+                              "communication_window": 2},
+              data=ds)
+    model = job.run(timeout=240)
+    assert model.predict(feats).shape == (512, 4)
+
+
+def test_npz_path_dataset(punchcard, tmp_path):
+    feats, onehot, _ = _toy_data()
+    np.savez(tmp_path / "train.npz", features=feats, label=onehot)
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="npz-job",
+              model=_spec(), trainer="single",
+              trainer_kwargs={"num_epoch": 2, "batch_size": 32},
+              dataset_path="train.npz")
+    model = job.run(timeout=120)
+    assert model.predict(feats).shape == (256, 4)
+
+
+def test_wrong_secret_rejected(punchcard):
+    feats, onehot, _ = _toy_data(n=64)
+    job = Job("127.0.0.1", punchcard.port, "wrong-secret", name="intruder",
+              model=_spec(), trainer="single",
+              data=Dataset({"features": feats, "label": onehot}))
+    with pytest.raises(PermissionError):
+        job.submit()
+    assert list_jobs("127.0.0.1", punchcard.port, SECRET) == []
+
+
+def test_path_traversal_rejected(punchcard):
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="escape",
+              model=_spec(), trainer="single",
+              dataset_path="../../../etc/passwd")
+    with pytest.raises(RuntimeError, match="escapes the data root"):
+        job.submit()
+
+
+def test_unknown_trainer_rejected(punchcard):
+    feats, onehot, _ = _toy_data(n=64)
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="bogus",
+              model=_spec(), trainer="single",
+              data=Dataset({"features": feats, "label": onehot}))
+    job.trainer = "spark-rdd"  # not a thing here
+    with pytest.raises(RuntimeError, match="unknown trainer"):
+        job.submit()
+
+
+def test_unknown_job_id(punchcard):
+    feats, onehot, _ = _toy_data(n=64)
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="ghost",
+              model=_spec(), trainer="single",
+              data=Dataset({"features": feats, "label": onehot}))
+    job.job_id = "nonexistent"
+    with pytest.raises(RuntimeError, match="unknown job_id"):
+        job.status()
+
+
+def test_failed_job_surfaces_error(punchcard):
+    # 8 rows with batch_size 64 -> trainer raises; job must land in FAILED
+    feats, onehot, _ = _toy_data(n=8)
+    job = Job("127.0.0.1", punchcard.port, SECRET, name="doomed",
+              model=_spec(), trainer="single",
+              trainer_kwargs={"num_epoch": 1, "batch_size": 64},
+              data=Dataset({"features": feats, "label": onehot}))
+    job.submit()
+    st = job.wait(timeout=60)
+    assert st["state"] == FAILED
+    assert st["error"]
+    with pytest.raises(RuntimeError, match="not done"):
+        job.fetch_models()
+
+
+def test_fifo_queue_and_list(punchcard):
+    feats, onehot, _ = _toy_data(n=128)
+    ds = Dataset({"features": feats, "label": onehot})
+    jobs = []
+    for i in range(3):
+        j = Job("127.0.0.1", punchcard.port, SECRET, name=f"q{i}",
+                model=_spec(), trainer="single",
+                trainer_kwargs={"num_epoch": 1, "batch_size": 32},
+                data=ds)
+        j.submit()
+        jobs.append(j)
+    for j in jobs:
+        assert j.wait(timeout=120)["state"] == DONE
+    listed = list_jobs("127.0.0.1", punchcard.port, SECRET)
+    assert sorted(x["name"] for x in listed) == ["q0", "q1", "q2"]
+
+
+def test_remote_shutdown():
+    pc = Punchcard(secret=SECRET).start()
+    shutdown("127.0.0.1", pc.port, SECRET)
+    # daemon stops accepting: a fresh connect must fail once sockets close
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not pc._running:
+            break
+        time.sleep(0.05)
+    assert not pc._running
