@@ -1,0 +1,5 @@
+"""Runnable example workflows (reference parity: ``examples/`` notebooks).
+
+Installed with the package so ``distkeras-workflow`` works from any CWD;
+the repo-root ``examples/`` directory keeps thin shims for discoverability.
+"""

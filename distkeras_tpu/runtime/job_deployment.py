@@ -51,6 +51,12 @@ from distkeras_tpu.runtime import networking as net
 
 PROTOCOL_VERSION = 1
 
+# frame-size bounds: before auth only a tiny hello/auth message is legal;
+# after auth, control JSON stays small; bulk tensor frames get their own cap
+AUTH_FRAME_LIMIT = 64 * 1024
+CTRL_FRAME_LIMIT = 8 * (1 << 20)
+DATA_FRAME_LIMIT = 8 * (1 << 30)
+
 # job lifecycle
 QUEUED = "queued"
 RUNNING = "running"
@@ -58,37 +64,39 @@ DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
 
-_TRAINER_NAMES = (
-    "single", "adag", "downpour", "aeasgd", "eamsgd", "dynsgd",
-    "averaging", "ensemble",
-    "async-adag", "async-downpour", "async-aeasgd", "async-eamsgd", "async-dynsgd",
-)
+# single source for name validation AND the late-import registry (the
+# daemon module must stay importable without jax)
+_TRAINER_PATHS = {
+    "single": ("distkeras_tpu.trainers", "SingleTrainer"),
+    "adag": ("distkeras_tpu.trainers", "ADAG"),
+    "downpour": ("distkeras_tpu.trainers", "DOWNPOUR"),
+    "aeasgd": ("distkeras_tpu.trainers", "AEASGD"),
+    "eamsgd": ("distkeras_tpu.trainers", "EAMSGD"),
+    "dynsgd": ("distkeras_tpu.trainers", "DynSGD"),
+    "averaging": ("distkeras_tpu.trainers", "AveragingTrainer"),
+    "ensemble": ("distkeras_tpu.trainers", "EnsembleTrainer"),
+    "async-adag": ("distkeras_tpu.runtime.async_trainer", "AsyncADAG"),
+    "async-downpour": ("distkeras_tpu.runtime.async_trainer", "AsyncDOWNPOUR"),
+    "async-aeasgd": ("distkeras_tpu.runtime.async_trainer", "AsyncAEASGD"),
+    "async-eamsgd": ("distkeras_tpu.runtime.async_trainer", "AsyncEAMSGD"),
+    "async-dynsgd": ("distkeras_tpu.runtime.async_trainer", "AsyncDynSGD"),
+}
+_TRAINER_NAMES = tuple(_TRAINER_PATHS)
 
 
 def _trainer_registry() -> Dict[str, Any]:
-    """Late import: the daemon module stays importable without jax."""
-    from distkeras_tpu import trainers as t
-    from distkeras_tpu.runtime import async_trainer as at
+    import importlib
 
-    return {
-        "single": t.SingleTrainer,
-        "adag": t.ADAG,
-        "downpour": t.DOWNPOUR,
-        "aeasgd": t.AEASGD,
-        "eamsgd": t.EAMSGD,
-        "dynsgd": t.DynSGD,
-        "averaging": t.AveragingTrainer,
-        "ensemble": t.EnsembleTrainer,
-        "async-adag": at.AsyncADAG,
-        "async-downpour": at.AsyncDOWNPOUR,
-        "async-aeasgd": at.AsyncAEASGD,
-        "async-eamsgd": at.AsyncEAMSGD,
-        "async-dynsgd": at.AsyncDynSGD,
-    }
+    return {name: getattr(importlib.import_module(mod), attr)
+            for name, (mod, attr) in _TRAINER_PATHS.items()}
 
 
 def _mac(secret: str, nonce: str) -> str:
     return hmac.new(secret.encode("utf-8"), bytes.fromhex(nonce), hashlib.sha256).hexdigest()
+
+
+class _FatalProtocolError(Exception):
+    """The connection's byte stream is desynced; report once, then drop."""
 
 
 class JobRecord:
@@ -187,24 +195,37 @@ class Punchcard:
         authed = False
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            net.send_json(conn, {"punchcard": PROTOCOL_VERSION, "nonce": nonce})
+            net.send_json(conn, {"punchcard": PROTOCOL_VERSION, "nonce": nonce,
+                                 "data_limit": DATA_FRAME_LIMIT})
             while self._running:
                 try:
-                    req = net.recv_json(conn)
+                    # pre-auth only the tiny auth message is legal; post-auth
+                    # control JSON gets the full control budget
+                    req = net.recv_json(
+                        conn, limit=CTRL_FRAME_LIMIT if authed else AUTH_FRAME_LIMIT)
                 except (ConnectionError, OSError):
                     return
                 except (ValueError, UnicodeDecodeError):
-                    return  # stream desync / non-JSON frame: drop connection
+                    return  # oversized / desynced / non-JSON frame: drop connection
+                if not isinstance(req, dict):
+                    return  # valid JSON but not a request object: drop
                 action = req.get("action")
                 if not authed:
                     mac = req.get("mac", "")
-                    if not hmac.compare_digest(mac, _mac(self._secret, nonce)):
+                    if not isinstance(mac, str) or \
+                            not hmac.compare_digest(mac, _mac(self._secret, nonce)):
                         net.send_json(conn, {"ok": False, "error": "authentication failed"})
                         return
                     authed = True
+                    if action == "auth":  # dedicated handshake message
+                        net.send_json(conn, {"ok": True})
+                        continue
                 try:
                     stop_after = self._dispatch(conn, action, req)
-                except Exception as e:  # protocol error: report, keep serving
+                except _FatalProtocolError as e:
+                    net.send_json(conn, {"ok": False, "error": str(e)})
+                    return  # stream is desynced; further frames are garbage
+                except Exception as e:  # request error: report, keep serving
                     net.send_json(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
                     continue
                 if stop_after:
@@ -258,24 +279,29 @@ class Punchcard:
     def _submit(self, conn: socket.socket, req: Dict[str, Any]) -> JobRecord:
         job = req["job"]
         dataset = job.get("dataset") or {}
-        # the inline tensor frame is already in flight right behind the
-        # submit message — consume it BEFORE any validation can raise, or
-        # the connection desyncs and the next recv_json reads tensor bytes
-        blobs = None
-        if "columns" in dataset:
-            _, blobs = net.recv_tensors(conn)
         trainer = job.get("trainer")
         if trainer not in _TRAINER_NAMES:
             raise ValueError(f"unknown trainer {trainer!r}; known: {_TRAINER_NAMES}")
         rec = JobRecord(uuid.uuid4().hex[:12], job)
-        if blobs is not None:
-            # blobs in schema order, reinterpreted by declared dtype/shape
+        if "columns" in dataset:
+            # two-phase inline upload: validation above happens BEFORE the
+            # go-ahead, so a rejected client never streams its dataset (and
+            # never hits a TCP reset racing the error reply); blobs arrive
+            # in schema order, reinterpreted by declared dtype/shape
+            net.send_json(conn, {"ok": True, "send_data": True})
+            try:
+                _, blobs = net.recv_tensors(conn, limit=DATA_FRAME_LIMIT)
+            except ValueError as e:
+                # declared frame over the data cap: unread payload bytes are
+                # in flight, the stream can't be reused
+                raise _FatalProtocolError(str(e)) from None
             schema = dataset["columns"]
             if len(blobs) != len(schema):
                 raise ValueError(f"inline data has {len(blobs)} tensors, schema {len(schema)}")
             cols = {}
             for meta, blob in zip(schema, blobs):
-                arr = np.frombuffer(blob.tobytes(), dtype=np.dtype(meta["dtype"]))
+                # zero-copy reinterpret of the received uint8 buffer
+                arr = np.frombuffer(blob, dtype=np.dtype(meta["dtype"]))
                 cols[meta["name"]] = arr.reshape(meta["shape"])
             rec.data = cols
         elif "path" in dataset:
@@ -301,19 +327,24 @@ class Punchcard:
     def _executor_loop(self) -> None:
         while True:
             job_id = self._queue.get()
-            if job_id is None:
-                return
+            if job_id is None or not self._running:
+                return  # stop() must not let queued jobs keep the devices
             rec = self._jobs[job_id]
-            with self._lock:
-                if rec.state != QUEUED:
-                    continue  # cancelled while queued
-                rec.state = RUNNING
             try:
+                with self._lock:
+                    if rec.state != QUEUED:
+                        continue  # cancelled while queued (finally still runs)
+                    rec.state = RUNNING
                 self._run(rec)
                 rec.state = DONE
             except Exception as e:
                 rec.error = f"{type(e).__name__}: {e}"
                 rec.state = FAILED
+            finally:
+                # a long-running daemon must not pin submitted datasets in
+                # RAM — cancelled ones included; only the fetchable model
+                # blobs outlive the run
+                rec.data = None
 
     def _run(self, rec: JobRecord) -> None:
         from distkeras_tpu.data.dataset import Dataset
@@ -336,6 +367,49 @@ class Punchcard:
         rec.model_blobs = [m.serialize() for m in models]
         rec.history = [float(x) for x in getattr(trainer, "history", [])]
         rec.training_time = trainer.get_training_time()
+
+
+class _Conn:
+    """One authenticated client connection; reusable for many requests
+    (the server's handler loop keeps serving until the socket closes)."""
+
+    def __init__(self, host: str, port: int, secret: str):
+        self.sock = net.connect(host, port)
+        try:
+            hello = net.recv_json(self.sock)
+            self.data_limit = hello.get("data_limit")
+            # dedicated auth handshake: proves the secret (and surfaces
+            # PermissionError) before any real payload is built or sent
+            net.send_json(self.sock, {"action": "auth",
+                                      "mac": _mac(secret, hello["nonce"])})
+            resp = net.recv_json(self.sock)
+            if not resp.get("ok"):
+                raise PermissionError(resp.get("error", "authentication failed"))
+        except BaseException:
+            self.close()
+            raise
+
+    def request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        net.send_json(self.sock, payload)
+        resp = net.recv_json(self.sock)
+        if not resp.get("ok"):
+            err = resp.get("error", "request failed")
+            if "authentication" in err:
+                raise PermissionError(err)
+            raise RuntimeError(err)
+        return resp
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "_Conn":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class Job:
@@ -365,25 +439,12 @@ class Job:
         self.job_id: Optional[str] = None
 
     # -- wire helpers ----------------------------------------------------------
-    def _request(self, payload: Dict[str, Any], and_then=None) -> Dict[str, Any]:
-        sock = net.connect(self.host, self.port)
-        try:
-            hello = net.recv_json(sock)
-            payload = dict(payload, mac=_mac(self.secret, hello["nonce"]))
-            net.send_json(sock, payload)
-            if and_then is not None:
-                and_then(sock)
-            resp = net.recv_json(sock)
-            if not resp.get("ok"):
-                err = resp.get("error", "request failed")
-                if "authentication" in err:
-                    raise PermissionError(err)
-                raise RuntimeError(err)
-            if payload["action"] == "fetch":
-                resp["_blobs"] = [net.recv_frame(sock) for _ in range(resp["num_models"])]
-            return resp
-        finally:
-            sock.close()
+    def _connect(self) -> _Conn:
+        return _Conn(self.host, self.port, self.secret)
+
+    def _request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        with self._connect() as conn:
+            return conn.request(payload)
 
     # -- public API ------------------------------------------------------------
     def submit(self) -> str:
@@ -393,17 +454,28 @@ class Job:
             "trainer_kwargs": self.trainer_kwargs,
             "model": self.model_spec.to_dict(),
         }
-        and_then = None
         if self._columns is not None:
             job["dataset"] = {"columns": [
                 {"name": k, "dtype": v.dtype.str, "shape": list(v.shape)}
                 for k, v in self._columns.items()]}
-
-            def and_then(sock, cols=self._columns):
-                net.send_tensors(sock, net.ACTION_COMMIT, list(cols.values()))
         else:
             job["dataset"] = {"path": self.dataset_path}
-        resp = self._request({"action": "submit", "job": job}, and_then=and_then)
+        with self._connect() as conn:
+            resp = conn.request({"action": "submit", "job": job})
+            if resp.get("send_data"):
+                # two-phase upload: the server validated the job and asked
+                # for the dataset; stream it and read the final reply
+                # pre-flight the encoded-frame size the server will check
+                nbytes = net.encoded_tensors_size(list(self._columns.values()))
+                if conn.data_limit and nbytes > conn.data_limit:
+                    raise ValueError(
+                        f"inline dataset frame is {nbytes} bytes; daemon accepts "
+                        f"at most {conn.data_limit} — use a server-side dataset_path")
+                net.send_tensors(conn.sock, net.ACTION_COMMIT,
+                                 list(self._columns.values()))
+                resp = net.recv_json(conn.sock)
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error", "submit failed"))
         self.job_id = resp["job_id"]
         return self.job_id
 
@@ -418,20 +490,29 @@ class Job:
         return self._request({"action": "cancel", "job_id": self.job_id})["state"]
 
     def wait(self, timeout: Optional[float] = None, poll_interval: float = 0.2) -> Dict[str, Any]:
+        if self.job_id is None:
+            raise RuntimeError("job not submitted")
         deadline = None if timeout is None else time.time() + timeout
-        while True:
-            st = self.status()
-            if st["state"] in (DONE, FAILED, CANCELLED):
-                return st
-            if deadline is not None and time.time() > deadline:
-                raise TimeoutError(f"job {self.job_id} still {st['state']} after {timeout}s")
-            time.sleep(poll_interval)
+        # one authenticated connection for the whole poll loop — not a fresh
+        # TCP+HMAC handshake per 0.2s status check
+        with self._connect() as conn:
+            while True:
+                st = conn.request({"action": "status", "job_id": self.job_id})
+                if st["state"] in (DONE, FAILED, CANCELLED):
+                    return st
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutError(f"job {self.job_id} still {st['state']} after {timeout}s")
+                time.sleep(poll_interval)
 
     def fetch_models(self) -> List[Any]:
         from distkeras_tpu.models.base import Model
 
-        resp = self._request({"action": "fetch", "job_id": self.job_id})
-        return [Model.deserialize(b) for b in resp["_blobs"]]
+        if self.job_id is None:
+            raise RuntimeError("job not submitted")
+        with self._connect() as conn:
+            resp = conn.request({"action": "fetch", "job_id": self.job_id})
+            blobs = [net.recv_frame(conn.sock) for _ in range(resp["num_models"])]
+        return [Model.deserialize(b) for b in blobs]
 
     def run(self, timeout: Optional[float] = None):
         """submit + wait + fetch; returns the trained Model (or list for
@@ -446,16 +527,14 @@ class Job:
 
 def list_jobs(host: str, port: int, secret: str) -> List[Dict[str, Any]]:
     """List all jobs known to a Punchcard daemon."""
-    j = Job.__new__(Job)
-    j.host, j.port, j.secret = host, port, secret
-    return j._request({"action": "list"})["jobs"]
+    with _Conn(host, port, secret) as conn:
+        return conn.request({"action": "list"})["jobs"]
 
 
 def shutdown(host: str, port: int, secret: str) -> None:
     """Remotely stop a Punchcard daemon (authenticated)."""
-    j = Job.__new__(Job)
-    j.host, j.port, j.secret = host, port, secret
-    j._request({"action": "shutdown"})
+    with _Conn(host, port, secret) as conn:
+        conn.request({"action": "shutdown"})
 
 
 def main(argv: Optional[List[str]] = None) -> None:

@@ -81,10 +81,14 @@ def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack(">Q", len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket, limit: int = MAX_FRAME) -> bytes:
+    """Receive one frame; ``limit`` bounds the declared payload size BEFORE
+    any allocation happens, so an untrusted peer can't force a huge
+    ``bytearray`` with an 8-byte header (servers pass a small limit until
+    the peer has authenticated)."""
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
-    if n > MAX_FRAME:
-        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME={MAX_FRAME}")
+    if n > limit:
+        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
     return _recv_exact(sock, n)
 
 
@@ -94,8 +98,8 @@ def send_json(sock: socket.socket, obj: Dict[str, Any]) -> None:
     send_frame(sock, json.dumps(obj).encode("utf-8"))
 
 
-def recv_json(sock: socket.socket) -> Dict[str, Any]:
-    return json.loads(recv_frame(sock).decode("utf-8"))
+def recv_json(sock: socket.socket, limit: int = MAX_FRAME) -> Dict[str, Any]:
+    return json.loads(recv_frame(sock, limit=limit).decode("utf-8"))
 
 
 # -- gradient plane: action + raw tensor frames -------------------------------
@@ -124,16 +128,23 @@ def decode_tensors(payload: bytes) -> Tuple[bytes, List[bytes]]:
     return action, blobs
 
 
+def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:
+    """Exact wire size of ``encode_tensors(action, arrays)`` — kept next to
+    the encoder so senders can pre-flight size limits without duplicating
+    the frame layout."""
+    return 5 + sum(8 + np.asarray(a).nbytes for a in arrays)
+
+
 def send_tensors(sock: socket.socket, action: bytes, arrays: Sequence[np.ndarray]) -> None:
     send_frame(sock, encode_tensors(action, arrays))
 
 
-def recv_tensors(sock: socket.socket, templates: Optional[Sequence[np.ndarray]] = None
-                 ) -> Tuple[bytes, List[np.ndarray]]:
+def recv_tensors(sock: socket.socket, templates: Optional[Sequence[np.ndarray]] = None,
+                 limit: int = MAX_FRAME) -> Tuple[bytes, List[np.ndarray]]:
     """Receive an (action, tensors) frame.  With ``templates``, each blob is
     reinterpreted with the template's dtype/shape (the out-of-band schema);
     without, raw ``uint8`` arrays are returned."""
-    action, blobs = decode_tensors(recv_frame(sock))
+    action, blobs = decode_tensors(recv_frame(sock, limit=limit))
     if templates is None:
         return action, [np.frombuffer(b, dtype=np.uint8) for b in blobs]
     if len(blobs) != len(templates):

@@ -6,7 +6,14 @@ so every mesh/collective path runs in CI without TPU hardware.  Must be
 set before jax initializes — hence here, at conftest import time.
 """
 
-from distkeras_tpu.platform import pin_cpu_devices
+import os
+import sys
+
+# repo-root modules (bench.py, __graft_entry__.py) are test subjects too;
+# make them importable regardless of the CWD pytest is invoked from
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distkeras_tpu.platform import pin_cpu_devices  # noqa: E402
 
 pin_cpu_devices(8)
 

@@ -149,6 +149,43 @@ def test_fifo_queue_and_list(punchcard):
     assert sorted(x["name"] for x in listed) == ["q0", "q1", "q2"]
 
 
+def test_oversized_preauth_frame_dropped(punchcard):
+    # an unauthenticated peer declaring a huge frame must be disconnected
+    # without the server allocating the declared size
+    import socket
+    import struct
+
+    from distkeras_tpu.runtime import networking as net
+
+    sock = socket.create_connection(("127.0.0.1", punchcard.port), timeout=5)
+    try:
+        net.recv_json(sock)  # hello
+        sock.sendall(struct.pack(">Q", 1 << 33))  # "16 GiB incoming"
+        sock.settimeout(5)
+        assert sock.recv(1) == b""  # server hung up, no reply
+    finally:
+        sock.close()
+    # daemon still healthy afterwards
+    assert list_jobs("127.0.0.1", punchcard.port, SECRET) == []
+
+
+def test_wrong_secret_never_uploads_data(punchcard, monkeypatch):
+    # two-phase submit: a rejected client must fail BEFORE streaming tensors
+    from distkeras_tpu.runtime import job_deployment as jd
+
+    sent = []
+    real = jd.net.send_tensors
+    monkeypatch.setattr(jd.net, "send_tensors",
+                        lambda *a, **kw: (sent.append(1), real(*a, **kw)))
+    feats, onehot, _ = _toy_data(n=64)
+    job = Job("127.0.0.1", punchcard.port, "wrong-secret", name="intruder2",
+              model=_spec(), trainer="single",
+              data=Dataset({"features": feats, "label": onehot}))
+    with pytest.raises(PermissionError):
+        job.submit()
+    assert sent == []
+
+
 def test_remote_shutdown():
     pc = Punchcard(secret=SECRET).start()
     shutdown("127.0.0.1", pc.port, SECRET)
