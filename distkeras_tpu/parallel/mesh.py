@@ -4,8 +4,10 @@ The reference's "cluster" was Spark executors + a TCP hub on the driver
 (SURVEY.md §2.14).  Here the cluster is a ``jax.sharding.Mesh``: the
 ``replica`` axis carries data parallelism (one replica = one reference
 "worker"), and richer meshes (dp × tp × sp) serve the TPU-native models.
-Collectives ride ICI within a slice; ``jax.distributed`` extends the same
-mesh across hosts over DCN with no code change in the trainers.
+Collectives ride ICI within a slice; across hosts, join processes with
+``runtime/launcher.py :: initialize_multihost`` first — ``jax.devices()``
+then spans every host and these helpers build the same mesh over DCN
+(exercised by ``tests/test_multihost.py`` with 2 real processes).
 """
 
 from __future__ import annotations

@@ -22,8 +22,14 @@ Differences from the reference's execution (same semantics, new substrate):
 Worker threads in one process share the single JAX runtime; with multiple
 devices visible each worker pins its compute to ``devices[i % n]``, giving
 real device-parallel async training in one process (the test/CI shape).
-Multi-host: run one ``AsyncWorker``-driving process per host, pointed at
-the same PS address.
+
+Multi-host topology (exercised by ``tests/test_multihost.py``): a
+standalone hub on the head node
+(``runtime/launcher.py :: start_parameter_server`` / ``distkeras-ps``),
+and on every worker host one Async* trainer constructed with
+``ps_address=(head, port)`` — worker-only mode: it starts no hub, drives
+its local shard against the remote one, and returns the center pulled at
+finish.
 """
 
 from __future__ import annotations
@@ -67,11 +73,15 @@ class AsyncDistributedTrainer(Trainer):
     thread per partition, joins, returns the PS's center model."""
 
     def __init__(self, model, num_workers: int = 2, communication_window: int = 5,
-                 native_ps: bool = False, **kwargs):
+                 native_ps: bool = False,
+                 ps_address: Optional[Tuple[str, int]] = None, **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.native_ps = bool(native_ps)
+        # worker-only mode (multi-host): connect to an external hub at this
+        # (host, port) instead of starting one; see module docstring
+        self.ps_address = tuple(ps_address) if ps_address is not None else None
         self.parameter_server: Optional[Any] = None
 
     # -- factories (reference: allocate_worker / allocate_parameter_server) ---
@@ -96,8 +106,13 @@ class AsyncDistributedTrainer(Trainer):
                 "for preemption-safe training")
         self.record_training_start()
         flat0, treedef = flatten_weights(self.model.params)
-        ps = self.allocate_parameter_server([w.astype(np.float32) for w in flat0])
-        ps.start()
+        if self.ps_address is not None:
+            ps = None
+            ps_host, ps_port = self.ps_address
+        else:
+            ps = self.allocate_parameter_server([w.astype(np.float32) for w in flat0])
+            ps.start()
+            ps_host, ps_port = "127.0.0.1", ps.port
         self.parameter_server = ps
 
         window_fn = _make_window_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
@@ -111,7 +126,7 @@ class AsyncDistributedTrainer(Trainer):
         def run_worker(idx: int) -> None:
             try:
                 device = devices[idx % len(devices)]
-                client = PSClient("127.0.0.1", ps.port, templates=flat0)
+                client = PSClient(ps_host, ps_port, templates=flat0)
                 try:
                     shard = dataset.shard(self.num_workers, idx)
                     local_flat = client.pull()
@@ -144,14 +159,22 @@ class AsyncDistributedTrainer(Trainer):
             t.start()
         for t in threads:
             t.join()
-        ps.stop()
+        if ps is not None:
+            ps.stop()
         if errors:
+            # surface the workers' root cause before touching the hub again
+            # (it may be gone, and that must not mask the real failure)
             raise errors[0]
+        if ps is None:
+            # worker-only mode: the external hub outlives us; read the center
+            with PSClient(ps_host, ps_port, templates=flat0) as final_client:
+                final = final_client.pull()
+        else:
+            final = ps.get_weights()
         # interleave per-worker histories into one trace (order is arbitrary
         # under real asynchrony; per-worker order is preserved)
         for h in histories:
             self.history.extend(h)
-        final = ps.get_weights()
         self.model = Model(spec=self.model.spec,
                            params=jax.tree.unflatten(treedef, [jnp.asarray(w) for w in final]))
         self.record_training_end()

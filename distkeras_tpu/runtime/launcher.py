@@ -1,0 +1,165 @@
+"""Multi-host launch helpers — the Spark-cluster replacement (SURVEY §2.14).
+
+The reference scaled out by letting Spark place one worker per executor
+and pointing them all at the driver's TCP parameter server.  This module
+provides the two TPU-native equivalents:
+
+1. **SPMD multi-host** (sync mesh trainers): every host runs the SAME
+   program; :func:`initialize_multihost` wires the hosts into one JAX
+   runtime (coordinator handshake, Gloo/ICI collectives), after which
+   ``jax.devices()`` is the global device list and the existing mesh
+   trainers work unchanged — collectives ride ICI within a slice and DCN
+   across hosts.  :func:`process_shard` gives each host its slice of the
+   data (the reference's ``df.repartition(num_workers)``).
+
+2. **PS multi-host** (async family): :func:`start_parameter_server` runs
+   the hub standalone (CLI: ``distkeras-ps``) on a head node; worker hosts
+   run Async* trainers with ``ps_address=(head, port)`` — one process per
+   host, the reference's actual topology with sockets replacing Spark.
+
+Both paths are exercised by ``tests/test_multihost.py`` with real separate
+processes on CPU (2 processes x 2 virtual devices), the CI stand-in for
+2 TPU hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None,
+                         cpu_devices_per_process: Optional[int] = None) -> None:
+    """Join this process into a multi-host JAX runtime.
+
+    Thin, env-var-aware wrapper over ``jax.distributed.initialize``:
+    arguments fall back to ``DKT_COORDINATOR`` / ``DKT_NUM_PROCESSES`` /
+    ``DKT_PROCESS_ID``, and on real TPU pods everything may be ``None``
+    (JAX auto-discovers from the TPU metadata).
+
+    ``cpu_devices_per_process`` simulates a multi-host slice on CPU: it
+    pins the CPU platform with that many virtual devices BEFORE the
+    coordinator handshake (the 2-hosts-in-CI shape; cross-process
+    collectives run over Gloo).  Must be called before any backend use.
+    """
+    import jax
+
+    if cpu_devices_per_process is not None:
+        # jax_num_cpu_devices wins over any inherited XLA_FLAGS device-count
+        # (pin_cpu_devices' fallback path, made the primary here)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", int(cpu_devices_per_process))
+
+    coordinator_address = coordinator_address or os.environ.get("DKT_COORDINATOR")
+    if num_processes is None and "DKT_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DKT_NUM_PROCESSES"])
+    if process_id is None and "DKT_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DKT_PROCESS_ID"])
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    if cpu_devices_per_process is not None:
+        local = len(jax.local_devices())
+        if local != cpu_devices_per_process:
+            raise RuntimeError(
+                f"requested {cpu_devices_per_process} local CPU devices, got {local} "
+                f"(a backend may have initialized before initialize_multihost)")
+
+
+def process_shard(dataset: Any) -> Any:
+    """This host's contiguous shard of the dataset — the multi-host data
+    plane (reference: Spark repartition handing each worker one partition).
+    Identity when running single-process."""
+    import jax
+
+    n, i = jax.process_count(), jax.process_index()
+    return dataset if n == 1 else dataset.shard(n, i)
+
+
+def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1,
+                           host: str = "0.0.0.0", port: int = 0,
+                           native: bool = False) -> Any:
+    """Start a standalone PS hub serving ``model``'s weights (head-node side
+    of the async multi-host topology).  Returns the started server; read
+    ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
+
+    ``mode``: ``delta`` (DOWNPOUR/elastic) | ``adag`` | ``dynsgd``.
+    ``native=True`` uses the C++ hub (commits apply outside the GIL).
+    """
+    from distkeras_tpu.utils import flatten_weights
+
+    flat, _ = flatten_weights(model.params)
+    weights = [np.asarray(w, dtype=np.float32) for w in flat]
+    if native:
+        from distkeras_tpu.runtime.native import (
+            MODE_ADAG, MODE_DELTA, MODE_DYNSGD, NativeParameterServer)
+
+        native_mode = {"delta": MODE_DELTA, "adag": MODE_ADAG, "dynsgd": MODE_DYNSGD}[mode]
+        # the C++ hub binds all interfaces; host selection is Python-hub only
+        ps = NativeParameterServer(weights, mode=native_mode, num_workers=num_workers,
+                                   port=port)
+    else:
+        from distkeras_tpu.runtime.parameter_server import (
+            ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer)
+
+        cls = {"delta": DeltaParameterServer, "adag": ADAGParameterServer,
+               "dynsgd": DynSGDParameterServer}[mode]
+        kwargs = {"num_workers": num_workers} if mode == "adag" else {}
+        ps = cls(weights, host=host, port=port, **kwargs)
+    ps.start()
+    return ps
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """``distkeras-ps``: serve a standalone PS hub for async multi-host runs.
+
+    The model file is the no-pickle ``Model.serialize()`` blob (produce one
+    with ``Model.init(spec).save(path)`` / ``open(path,'wb').write(m.serialize())``).
+    """
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(description="dist-keras-tpu parameter-server daemon")
+    parser.add_argument("--model", required=True, help="serialized Model file")
+    parser.add_argument("--mode", default="delta", choices=["delta", "adag", "dynsgd"])
+    parser.add_argument("--num-workers", type=int, default=1,
+                        help="expected worker count (adag normalization)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=5000)
+    parser.add_argument("--native", action="store_true", help="use the C++ hub")
+    parser.add_argument("--save-final", default=None,
+                        help="on shutdown, write the final center model here")
+    args = parser.parse_args(argv)
+
+    from distkeras_tpu.models.base import Model
+
+    with open(args.model, "rb") as f:
+        model = Model.deserialize(f.read())
+    ps = start_parameter_server(model, mode=args.mode, num_workers=args.num_workers,
+                                host=args.host, port=args.port, native=args.native)
+    print(f"ps listening on {args.host}:{ps.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ps.stop()
+        if args.save_final:
+            from distkeras_tpu.utils import flatten_weights, unflatten_weights
+
+            _, treedef = flatten_weights(model.params)
+            final = Model(spec=model.spec,
+                          params=unflatten_weights(treedef, ps.get_weights()))
+            with open(args.save_final, "wb") as f:
+                f.write(final.serialize())
+            print(f"final model written to {args.save_final}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

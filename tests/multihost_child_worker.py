@@ -1,0 +1,39 @@
+"""Child process for the async multi-host PS test (not collected by pytest).
+
+Runs a worker-only AsyncDOWNPOUR trainer (``ps_address=``) against a
+parameter-server hub owned by another process — the worker-host side of
+the reference's driver/executor topology.
+
+Usage: python multihost_child_worker.py <ps_port> <shard_idx> <num_shards> <npz_path>
+"""
+
+import sys
+
+ps_port, shard_idx, num_shards, npz_path = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+from distkeras_tpu.platform import pin_cpu_devices  # noqa: E402
+
+pin_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from distkeras_tpu.data.dataset import Dataset  # noqa: E402
+from distkeras_tpu.models.base import ModelSpec  # noqa: E402
+from distkeras_tpu.runtime.async_trainer import AsyncDOWNPOUR  # noqa: E402
+
+with np.load(npz_path) as z:
+    ds = Dataset({k: z[k] for k in z.files}).shard(num_shards, shard_idx)
+
+# must match the parent's spec/seed so the flat weight templates line up
+spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                 input_shape=(8,))
+trainer = AsyncDOWNPOUR(spec, num_workers=1, communication_window=2,
+                        ps_address=("127.0.0.1", ps_port),
+                        loss="categorical_crossentropy", worker_optimizer="sgd",
+                        learning_rate=0.05, batch_size=16, num_epoch=2, seed=0)
+model = trainer.train(ds)
+assert len(trainer.history) > 0
+assert np.isfinite(trainer.history).all()
+print(f"OK shard={shard_idx} windows={len(trainer.history)} "
+      f"loss0={trainer.history[0]:.4f} lossN={trainer.history[-1]:.4f}", flush=True)
