@@ -85,6 +85,35 @@ class Dataset:
         for i in range(0, end, batch_size):
             yield {c: self._columns[c][i : i + batch_size] for c in names}
 
+    def chunked_epoch(self, batch_size: int, columns: Sequence[str],
+                      window: int = 1, chunk_windows: Optional[int] = None
+                      ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield the epoch in bounded chunks of ``[n, window, batch, ...]``.
+
+        The memory-bounded form of :meth:`stacked_epoch`: at most
+        ``chunk_windows`` windows are materialized per yield (each chunk is
+        a zero-copy reshape of a column slice), so epoch feeding is
+        O(chunk), not O(dataset) — the host-sharded-feeding story for data
+        that doesn't fit the single-transfer fast path.  ``None`` yields
+        the whole epoch as one chunk.  The final chunk may be smaller
+        (possible one-off recompile of the epoch program for that shape).
+        """
+        per_window = batch_size * window
+        num_windows = len(self) // per_window
+        if num_windows == 0:
+            raise ValueError(
+                f"dataset of {len(self)} rows too small for batch_size={batch_size} window={window}")
+        step = num_windows if chunk_windows is None else int(chunk_windows)
+        if step <= 0:
+            raise ValueError(f"chunk_windows must be positive, got {chunk_windows}")
+        for start in range(0, num_windows, step):
+            n = min(step, num_windows - start)
+            out = {}
+            for c in columns:
+                v = self._columns[c][start * per_window:(start + n) * per_window]
+                out[c] = v.reshape((n, window, batch_size) + v.shape[1:])
+            yield out
+
     def stacked_epoch(self, batch_size: int, columns: Sequence[str],
                       window: int = 1) -> Dict[str, np.ndarray]:
         """Materialize one epoch as [num_windows, window, batch, ...] arrays.
@@ -93,14 +122,6 @@ class Dataset:
         becomes one device transfer and the train loop runs as a compiled
         ``lax.scan`` over windows instead of a Python batch loop — the
         replacement for the reference's per-row partition iterators.
+        (Exactly the single-chunk case of :meth:`chunked_epoch`.)
         """
-        per_window = batch_size * window
-        num_windows = len(self) // per_window
-        if num_windows == 0:
-            raise ValueError(
-                f"dataset of {len(self)} rows too small for batch_size={batch_size} window={window}")
-        out = {}
-        for c in columns:
-            v = self._columns[c][: num_windows * per_window]
-            out[c] = v.reshape((num_windows, window, batch_size) + v.shape[1:])
-        return out
+        return next(self.chunked_epoch(batch_size, columns, window=window))

@@ -1,0 +1,136 @@
+"""Dataset loaders for the BASELINE.md measurement matrix.
+
+Reference parity: the reference's examples fed MNIST / CIFAR / Higgs CSVs
+through Spark DataFrames (SURVEY §2.21).  Here loaders produce columnar
+:class:`Dataset` pairs directly.
+
+Offline-first design: loaders search local caches for the standard
+``.npz`` archives and NEVER download.  When no cache exists they fall back
+to deterministic, clearly-labeled synthetic stand-ins with identical
+shapes/dtypes (class-prototype clusters — learnable, so accuracy targets
+still exercise the full train/eval loop), and the returned ``info`` dict
+says so: benchmark records must carry the ``synthetic`` flag.
+
+Cache search order: explicit ``cache_dir`` arg, ``$DKT_DATA_DIR``,
+``~/.keras/datasets``, ``~/.cache/distkeras_tpu``, ``./data``.
+
+Expected archive formats (all no-pickle):
+- ``mnist.npz``   — keys ``x_train, y_train, x_test, y_test`` (Keras layout)
+- ``cifar10.npz`` / ``cifar100.npz`` — same keys; images [N, 32, 32, 3] uint8
+  (convert the upstream pickled python batches once, offline, with any tool)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def _search_dirs(cache_dir: Optional[str]):
+    dirs = []
+    if cache_dir:
+        dirs.append(cache_dir)
+    if os.environ.get("DKT_DATA_DIR"):
+        dirs.append(os.environ["DKT_DATA_DIR"])
+    home = os.path.expanduser("~")
+    dirs += [os.path.join(home, ".keras", "datasets"),
+             os.path.join(home, ".cache", "distkeras_tpu"),
+             os.path.join(os.getcwd(), "data")]
+    return dirs
+
+
+def _find_npz(filename: str, cache_dir: Optional[str]) -> Optional[str]:
+    for d in _search_dirs(cache_dir):
+        path = os.path.join(d, filename)
+        if os.path.exists(path):
+            return path
+    return None
+
+
+def _synthetic_images(num_classes: int, shape: Tuple[int, ...], n_train: int,
+                      n_test: int, seed: int):
+    """Class-prototype images + noise: same shape/dtype as the real set,
+    deterministic, and separable enough that accuracy targets are
+    meaningful for the training loop being measured."""
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(0.0, 255.0, size=(num_classes,) + shape).astype(np.float32)
+
+    def make(n, split_seed):
+        r = np.random.default_rng(split_seed)
+        labels = r.integers(0, num_classes, size=n)
+        imgs = protos[labels] + r.normal(0.0, 64.0, size=(n,) + shape).astype(np.float32)
+        return np.clip(imgs, 0, 255).astype(np.uint8), labels.astype(np.int64)
+
+    xtr, ytr = make(n_train, seed + 1)
+    xte, yte = make(n_test, seed + 2)
+    return xtr, ytr, xte, yte
+
+
+def _to_datasets(x_train, y_train, x_test, y_test, num_classes: int,
+                 flatten: bool) -> Tuple[Dataset, Dataset]:
+    def prep(x, y):
+        feats = np.asarray(x, np.float32) / 255.0
+        if feats.ndim == 3:  # grayscale [N, H, W] -> [N, H, W, 1]
+            feats = feats[..., None]
+        if flatten:
+            feats = feats.reshape(len(feats), -1)
+        y = np.asarray(y).reshape(-1).astype(np.int32)
+        return Dataset({"features": feats,
+                        "label": np.eye(num_classes, dtype=np.float32)[y],
+                        "label_index": y})
+
+    return prep(x_train, y_train), prep(x_test, y_test)
+
+
+def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
+          synthetic_sizes: Tuple[int, int], seed: int, cache_dir: Optional[str],
+          synthetic_fallback: bool, flatten: bool
+          ) -> Tuple[Dataset, Dataset, Dict]:
+    path = _find_npz(filename, cache_dir)
+    if path is not None:
+        with np.load(path) as z:
+            xtr, ytr = z["x_train"], z["y_train"]
+            xte, yte = z["x_test"], z["y_test"]
+        info = {"synthetic": False, "source": path}
+    elif synthetic_fallback:
+        xtr, ytr, xte, yte = _synthetic_images(
+            num_classes, image_shape, *synthetic_sizes, seed=seed)
+        info = {"synthetic": True,
+                "source": f"deterministic synthetic stand-in (no {filename} in "
+                          f"{_search_dirs(cache_dir)})"}
+    else:
+        raise FileNotFoundError(
+            f"{filename} not found in {_search_dirs(cache_dir)} and "
+            f"synthetic_fallback=False (this environment has no network access)")
+    train, test = _to_datasets(xtr, ytr, xte, yte, num_classes, flatten)
+    info.update(num_classes=num_classes, train_rows=len(train), test_rows=len(test))
+    return train, test, info
+
+
+def load_mnist(cache_dir: Optional[str] = None, synthetic_fallback: bool = True,
+               flatten: bool = False) -> Tuple[Dataset, Dataset, Dict]:
+    """MNIST digits: features [N, 28, 28, 1] float32 in [0,1] (or flat 784),
+    ``label`` one-hot, ``label_index`` int32.  Returns (train, test, info)."""
+    return _load("mnist.npz", 10, (28, 28), (60000, 10000), seed=1234,
+                 cache_dir=cache_dir, synthetic_fallback=synthetic_fallback,
+                 flatten=flatten)
+
+
+def load_cifar10(cache_dir: Optional[str] = None, synthetic_fallback: bool = True
+                 ) -> Tuple[Dataset, Dataset, Dict]:
+    """CIFAR-10: features [N, 32, 32, 3] float32 in [0,1]."""
+    return _load("cifar10.npz", 10, (32, 32, 3), (50000, 10000), seed=2345,
+                 cache_dir=cache_dir, synthetic_fallback=synthetic_fallback,
+                 flatten=False)
+
+
+def load_cifar100(cache_dir: Optional[str] = None, synthetic_fallback: bool = True
+                  ) -> Tuple[Dataset, Dataset, Dict]:
+    """CIFAR-100: features [N, 32, 32, 3] float32 in [0,1], 100 classes."""
+    return _load("cifar100.npz", 100, (32, 32, 3), (50000, 10000), seed=3456,
+                 cache_dir=cache_dir, synthetic_fallback=synthetic_fallback,
+                 flatten=False)

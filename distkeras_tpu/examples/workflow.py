@@ -71,10 +71,15 @@ def main() -> None:
                   num_epoch=args.epochs)
     dist = dict(num_workers=args.workers, communication_window=4)
 
+    # DOWNPOUR's commit adds every replica's delta UNSCALED (reference
+    # semantics), so its stable lr shrinks with the replica count
+    n_replicas = args.workers or len(jax.devices())
+    downpour_common = dict(common, learning_rate=common["learning_rate"] / max(n_replicas, 1))
+
     trainers = {
         "single": lambda: SingleTrainer(spec, **common),
         "adag": lambda: ADAG(spec, **common, **dist),
-        "downpour": lambda: DOWNPOUR(spec, **common, **dist),
+        "downpour": lambda: DOWNPOUR(spec, **downpour_common, **dist),
         "aeasgd": lambda: AEASGD(spec, rho=1.0, **common, **dist),
         "eamsgd": lambda: EAMSGD(spec, rho=1.0, momentum=0.9, **{**common, "worker_optimizer": "nesterov"}, **dist),
         "dynsgd": lambda: DynSGD(spec, **common, **dist),

@@ -126,9 +126,13 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
         axis_name = None  # traced outside any shard_map: dense is exact
     if axis_name is None:
         if impl is None:
-            # flash needs Mosaic-legal blocks and enough rows per block to
-            # beat XLA's fused softmax-attention; 128-divisible covers both
-            impl = ("flash" if (jax.default_backend() == "tpu" and q.shape[1] >= 512
+            # flash needs Mosaic-legal blocks AND enough total work to beat
+            # XLA's fused softmax-attention: measured on v5e (fwd+bwd,
+            # 2026-07-30 sweep) flash wins at B*L >= 16k tokens with
+            # L >= 2048 (1.2-1.7x) and loses below (0.8x at B=2, L=2048)
+            tokens = q.shape[0] * q.shape[1]
+            impl = ("flash" if (jax.default_backend() == "tpu"
+                                and q.shape[1] >= 2048 and tokens >= 16384
                                 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
                     else "dense")
         if impl == "flash":

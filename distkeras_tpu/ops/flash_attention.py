@@ -75,6 +75,22 @@ def _block_visible(cfg: _Config, qi, kj):
     return last_q_pos >= first_k_pos
 
 
+def _apply_causal_mask(s, cfg: _Config, qi, kj):
+    """Mask ``s`` [bq, bk] where q_pos < k_pos — but only blocks that
+    straddle the diagonal pay for the iota+where; blocks fully below it
+    (first q row sees the last k column) pass through untouched."""
+    bq, bk = cfg.block_q, cfg.block_k
+
+    def masked(s):
+        q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    first_q_pos = cfg.q_offset + qi * bq
+    last_k_pos = cfg.k_offset + (kj + 1) * bk - 1
+    return jax.lax.cond(first_q_pos >= last_k_pos, lambda s: s, masked, s)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 cfg: _Config, scale: float):
     qi, kj = pl.program_id(2), pl.program_id(3)
@@ -95,9 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if cfg.causal:
-            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _apply_causal_mask(s, cfg, qi, kj)
         m = m_scr[:, 0]
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
@@ -146,9 +160,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if cfg.causal:
-            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _apply_causal_mask(s, cfg, qi, kj)
         p = jnp.exp(s - lse)  # masked/-inf entries -> exactly 0
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -184,9 +196,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if cfg.causal:
-            q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _apply_causal_mask(s, cfg, qi, kj)
         p = jnp.exp(s - lse)
         dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -317,10 +327,18 @@ def _pick_block(block: int, length: int) -> int:
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, q_offset: int = 0, k_offset: int = 0,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, L, H, D] tensors (same layout/semantics as
     ``ops.attention.dense_attention``, including the shard offsets).
+
+    Default blocks (512, 1024) are from a v5e sweep (128..1024, fwd+bwd,
+    2026-07-30): 1.19x the XLA dense path at 2k tokens / 1.61x at 8k,
+    within ~7% of the (1024, 1024) peak (1.23x / 1.72x) while leaving
+    VMEM headroom — (1024, 1024) sits at 16.01M/16.00M scoped-vmem inside
+    full transformer backward programs and fails to compile there.  Small
+    blocks lose badly (128 runs at 0.4x dense).  ``_pick_block`` shrinks
+    blocks to fit short sequences automatically.
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     identical kernel code runs (slowly) in CPU tests.

@@ -123,11 +123,26 @@ class DynSGDAlgorithm(Algorithm):
 
     Reference: ``DynSGDParameterServer.handle_commit`` kept a global update
     clock and scaled each delta by ``1/(staleness+1)`` where staleness =
-    commits applied since that worker's pull.  Deterministic serialization:
-    replicas commit in rank order within the window, so replica r has
-    staleness r and the center advances by
+    commits applied since that worker's pull.
 
-        center' = center + sum_r (local_r - center) / (r + 1)
+    This sync form is the exact serialization of one specific async
+    schedule — *all replicas pull at the window start, train, then commit
+    in rank order; everyone re-pulls after the full window*:
+
+    - replica r's committed delta is ``local_r - center`` against the
+      center it PULLED (the async worker's delta is relative to its pull
+      point, NOT the center at commit time — reference §3.1);
+    - committing r-th means r commits landed since r's pull, so the hub
+      scales by ``1/(r+1)``;
+
+        c_{r+1} = c_r + (local_r - c_0) / (r + 1)
+      ⇒ center' = c_0 + sum_r (local_r - c_0) / (r + 1)
+
+    which is the psum below.  Note rank r is *permanently* scaled by
+    1/(r+1) under this schedule — real async runs randomize commit order,
+    this serialization fixes it for determinism.  The equivalence against
+    the async hub under the same schedule is proven by
+    ``tests/test_algorithms.py :: test_dynsgd_sync_matches_async_hub``.
     """
 
     name = "dynsgd"

@@ -118,8 +118,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
 def main(argv: Optional[List[str]] = None) -> None:
     """``distkeras-ps``: serve a standalone PS hub for async multi-host runs.
 
-    The model file is the no-pickle ``Model.serialize()`` blob (produce one
-    with ``Model.init(spec).save(path)`` / ``open(path,'wb').write(m.serialize())``).
+    The model file is the no-pickle ``Model.serialize()`` blob:
+    ``open(path, 'wb').write(Model.init(spec).serialize())``.
     """
     import argparse
     import time

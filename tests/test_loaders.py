@@ -1,0 +1,115 @@
+"""Dataset loaders + chunked data plane."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.loaders import load_cifar10, load_cifar100, load_mnist
+
+
+def test_mnist_synthetic_fallback_shapes():
+    train, test, info = load_mnist()
+    assert info["synthetic"] is True  # offline environment
+    assert train["features"].shape == (60000, 28, 28, 1)
+    assert train["features"].dtype == np.float32
+    assert 0.0 <= train["features"].min() and train["features"].max() <= 1.0
+    assert train["label"].shape == (60000, 10)
+    assert test["label_index"].shape == (10000,)
+
+
+def test_mnist_flatten():
+    train, _, _ = load_mnist(flatten=True)
+    assert train["features"].shape == (60000, 784)
+
+
+def test_cifar_shapes():
+    train, test, info = load_cifar10()
+    assert train["features"].shape == (50000, 32, 32, 3)
+    train100, _, info100 = load_cifar100()
+    assert train100["label"].shape == (50000, 100)
+
+
+def test_synthetic_is_deterministic_and_learnable():
+    a, _, _ = load_mnist()
+    b, _, _ = load_mnist()
+    np.testing.assert_array_equal(a["features"][:16], b["features"][:16])
+    # nearest-prototype separability: a linear probe must beat chance easily
+    x = a["features"][:2000].reshape(2000, -1)
+    y = a["label_index"][:2000]
+    centers = np.stack([x[y == c].mean(axis=0) for c in range(10)])
+    pred = np.argmin(((x[:, None, :] - centers[None]) ** 2).sum(-1), axis=1)
+    assert (pred == y).mean() > 0.9
+
+
+def test_real_npz_cache_wins(tmp_path):
+    x_train = np.zeros((32, 28, 28), np.uint8)
+    y_train = np.arange(32) % 10
+    np.savez(tmp_path / "mnist.npz", x_train=x_train, y_train=y_train,
+             x_test=x_train[:8], y_test=y_train[:8])
+    train, test, info = load_mnist(cache_dir=str(tmp_path))
+    assert info["synthetic"] is False
+    assert train["features"].shape == (32, 28, 28, 1)
+    assert len(test) == 8
+
+
+def test_no_fallback_raises():
+    with pytest.raises(FileNotFoundError):
+        load_mnist(cache_dir="/nonexistent", synthetic_fallback=False)
+
+
+# -- chunked epoch -------------------------------------------------------------
+
+def _ds(n=100):
+    return Dataset({"features": np.arange(n * 3, dtype=np.float32).reshape(n, 3),
+                    "label": np.arange(n, dtype=np.int32)})
+
+
+def test_chunked_epoch_covers_same_rows_as_stacked():
+    ds = _ds(100)
+    stacked = ds.stacked_epoch(4, ["features", "label"], window=2)
+    chunks = list(ds.chunked_epoch(4, ["features", "label"], window=2, chunk_windows=5))
+    assert len(chunks) == 3  # 12 windows -> 5 + 5 + 2
+    assert [c["features"].shape[0] for c in chunks] == [5, 5, 2]
+    rejoined = np.concatenate([c["features"] for c in chunks])
+    np.testing.assert_array_equal(rejoined, stacked["features"])
+
+
+def test_chunked_epoch_default_is_one_chunk():
+    ds = _ds(64)
+    chunks = list(ds.chunked_epoch(8, ["features"], window=1))
+    assert len(chunks) == 1
+    assert chunks[0]["features"].shape == (8, 1, 8, 3)
+
+
+def test_chunked_epoch_chunks_are_views():
+    ds = _ds(64)
+    (chunk,) = ds.chunked_epoch(8, ["features"], window=1, chunk_windows=8)
+    assert chunk["features"].base is not None  # zero-copy reshape of a slice
+
+
+def test_chunked_training_matches_unchunked():
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.trainers import ADAG, SingleTrainer
+
+    rng = np.random.default_rng(0)
+    n = 256
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(int)
+    ds = Dataset({"features": x, "label": np.eye(2, dtype=np.float32)[y]})
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+
+    def run(cls, chunk_windows, **kw):
+        t = cls(spec, loss="categorical_crossentropy", worker_optimizer="sgd",
+                learning_rate=0.05, batch_size=8, num_epoch=2, seed=0,
+                chunk_windows=chunk_windows, **kw)
+        m = t.train(ds)
+        return t, m
+
+    for cls, kw in ((SingleTrainer, {}), (ADAG, {"communication_window": 2, "num_workers": 2})):
+        t_full, m_full = run(cls, None, **kw)
+        t_chunk, m_chunk = run(cls, 3, **kw)
+        assert t_full.history == pytest.approx(t_chunk.history, rel=1e-5)
+        for a, b in zip(np.asarray(list(m_full.params.values())[0]["kernel"]).ravel(),
+                        np.asarray(list(m_chunk.params.values())[0]["kernel"]).ravel()):
+            assert a == pytest.approx(b, rel=1e-5)

@@ -103,3 +103,55 @@ def test_determinism_same_seed_same_result(toy_dataset):
     m1, m2 = run(), run()
     for a, b in zip(jax.tree.leaves(m1.params), jax.tree.leaves(m2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metrics_recorded_single_and_distributed(toy_dataset):
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.trainers import ADAG, SingleTrainer
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(8,))
+    for cls, kw in ((SingleTrainer, {}), (ADAG, {"num_workers": 2, "communication_window": 2})):
+        t = cls(spec, loss="categorical_crossentropy", batch_size=16, num_epoch=2, **kw)
+        t.train(toy_dataset)
+        assert len(t.metrics) == 2
+        for rec in t.metrics:
+            assert rec["samples"] > 0 and rec["seconds"] > 0
+            assert rec["samples_per_sec_per_chip"] > 0
+        # every sample fed is accounted for exactly once per epoch
+        assert t.metrics[0]["samples"] <= len(toy_dataset)
+
+
+def test_profile_dir_writes_trace(toy_dataset, tmp_path):
+    import os
+
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.trainers import SingleTrainer
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(8,))
+    t = SingleTrainer(spec, loss="categorical_crossentropy", batch_size=16,
+                      num_epoch=1, profile_dir=str(tmp_path / "prof"))
+    t.train(toy_dataset)
+    files = [os.path.join(dp, f) for dp, _, fs in os.walk(tmp_path / "prof") for f in fs]
+    assert files, "profiler trace directory is empty"
+
+
+def test_async_rejects_non_float32_params():
+    import jax
+    import numpy as np
+    import pytest as _pytest
+
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.runtime.async_trainer import AsyncDOWNPOUR
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(4,))
+    m = Model.init(spec, seed=0)
+    m = Model(spec=spec, params=jax.tree.map(lambda x: x.astype("bfloat16"), m.params))
+    ds = Dataset({"features": np.zeros((64, 4), np.float32),
+                  "label": np.eye(2, dtype=np.float32)[np.zeros(64, int)]})
+    t = AsyncDOWNPOUR(m, num_workers=1, batch_size=16, num_epoch=1)
+    with _pytest.raises(TypeError, match="float32"):
+        t.train(ds)
