@@ -46,6 +46,11 @@ _EXPORTS = {
     "AsyncDynSGD": "distkeras_tpu.runtime.async_trainer",
     "Punchcard": "distkeras_tpu.runtime.job_deployment",
     "Job": "distkeras_tpu.runtime.job_deployment",
+    "StreamingInferenceServer": "distkeras_tpu.runtime.streaming",
+    "StreamingClient": "distkeras_tpu.runtime.streaming",
+    "initialize_multihost": "distkeras_tpu.runtime.launcher",
+    "process_shard": "distkeras_tpu.runtime.launcher",
+    "start_parameter_server": "distkeras_tpu.runtime.launcher",
     "Checkpointer": "distkeras_tpu.checkpoint",
     "Dataset": "distkeras_tpu.data.dataset",
     "Model": "distkeras_tpu.models.base",

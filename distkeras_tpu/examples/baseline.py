@@ -74,6 +74,9 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
     t0 = time.perf_counter()
     t_target = None
     for epoch in range(epochs_cap):
+        # distinct shuffle order per outer epoch: each train() call runs its
+        # internal epoch 0, whose shuffle seed is trainer.seed + 0
+        trainer.seed = epoch
         trainer.train(train_ds, shuffle=True)
         acc = float(_evaluate(trainer.model, test_ds))
         accs.append(round(acc, 4))
@@ -81,8 +84,10 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
             t_target = time.perf_counter() - t0
             break
     wall = time.perf_counter() - t0
-    # one extra epoch AFTER the target: every XLA program is already
-    # compiled, so its metrics record is the steady-state train-loop rate
+    # one extra epoch AFTER the target: the trainer's epoch program is
+    # cached across train() calls (SingleTrainer._epoch_fn / the engine on
+    # DistributedTrainer), so this record is the steady-state rate
+    trainer.seed = epochs_cap
     trainer.train(train_ds, shuffle=True)
     # chips actually engaged by this trainer (SingleTrainer=1, mesh trainers
     # = replica count) — NOT jax.device_count()

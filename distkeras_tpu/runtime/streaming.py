@@ -1,0 +1,220 @@
+"""Streaming inference service — reference parity for the Kafka pipeline.
+
+The reference's streaming story (SURVEY §2.21 [M]) was a notebook wiring
+Kafka + Spark Streaming to a Keras model: events arrive continuously, get
+micro-batched, scored, and emitted.  TPU-native redesign: a socket service
+holding ONE jit-compiled apply function with a single static batch shape —
+producers stream feature frames over the framed no-pickle transport and
+receive prediction frames back.  Padding to the static shape means every
+frame reuses the same XLA program: no recompiles, no Python per-row work,
+and the TPU stays hot across clients (connections share the program; JAX
+dispatch is thread-safe).
+
+Wire protocol (after :mod:`distkeras_tpu.runtime.networking`):
+
+    server hello: JSON {"streaming_predictor": 1, "row_shape": [...],
+                        "dtype": "...", "max_batch": N, "output_shape": [...]}
+    client frame: tensors(action 'C', [features [b, *row_shape]]), b <= N
+    server frame: tensors(action 'W', [predictions [b, *output_shape]])
+    action 'B' closes the connection.
+
+Use :class:`StreamingClient` (or ``stream_predict`` for an iterator-in,
+iterator-out pipeline — the shape of the reference's Kafka consumer loop).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from distkeras_tpu.runtime import networking as net
+
+
+class StreamingInferenceServer:
+    """Serve a model's predictions over TCP with one static-shape program.
+
+    ``max_batch`` is the compiled batch size: larger client frames are
+    rejected, smaller ones are padded (rows repeated) and truncated on
+    reply.  ``port=0`` binds an ephemeral port (read ``.port``).
+    """
+
+    def __init__(self, model: Any, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 256):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self._host, self._port = host, int(port)
+        self.max_batch = int(max_batch)
+        self.row_shape = tuple(model.spec.input_shape)
+        self.row_dtype = np.dtype(model.spec.input_dtype)
+        self._apply = jax.jit(model.spec.apply_fn())
+        # compile once up front and learn the output shape from it
+        dummy = jnp.zeros((self.max_batch,) + self.row_shape, self.row_dtype)
+        out = np.asarray(self._apply(model.params, dummy))
+        self.output_shape = tuple(out.shape[1:])
+        self.output_dtype = np.dtype(out.dtype)
+        self._jnp = jnp
+        self._sock: Optional[socket.socket] = None
+        self._running = False
+        self._threads: List[threading.Thread] = []
+
+    @property
+    def port(self) -> int:
+        if self._sock is None:
+            raise RuntimeError("server not started")
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "StreamingInferenceServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self._host, self._port))
+        self._sock.listen(64)
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        jnp = self._jnp
+        row_elems = int(np.prod(self.row_shape)) if self.row_shape else 1
+        try:
+            net.send_json(conn, {
+                "streaming_predictor": 1,
+                "row_shape": list(self.row_shape),
+                "dtype": self.row_dtype.str,
+                "max_batch": self.max_batch,
+                "output_shape": list(self.output_shape),
+                "output_dtype": self.output_dtype.str,
+            })
+            while self._running:
+                try:
+                    action, blobs = net.recv_tensors(
+                        conn, limit=16 + self.max_batch * row_elems * self.row_dtype.itemsize * 2)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if action == net.ACTION_BYE:
+                    return
+                if action != net.ACTION_COMMIT or len(blobs) != 1:
+                    net.send_json(conn, {"ok": False, "error": "expected one feature frame"})
+                    return
+                flat = np.frombuffer(blobs[0], dtype=self.row_dtype)
+                if flat.size % row_elems:
+                    net.send_json(conn, {"ok": False,
+                                         "error": f"frame size {flat.size} not a multiple "
+                                                  f"of row size {row_elems}"})
+                    return
+                batch = flat.reshape((-1,) + self.row_shape)
+                b = len(batch)
+                if b == 0 or b > self.max_batch:
+                    net.send_json(conn, {"ok": False,
+                                         "error": f"batch {b} outside 1..{self.max_batch}"})
+                    return
+                if b < self.max_batch:
+                    batch = np.concatenate(
+                        [batch, np.repeat(batch[-1:], self.max_batch - b, axis=0)])
+                preds = np.asarray(self._apply(self.model.params, jnp.asarray(batch)))[:b]
+                net.send_tensors(conn, net.ACTION_WEIGHTS, [np.ascontiguousarray(preds)])
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class StreamingClient:
+    """Producer-side handle: ``predict(batch) -> predictions``, reusable
+    across many micro-batches on one connection."""
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = 60.0):
+        self.sock = net.connect(host, port, timeout=timeout)
+        hello = net.recv_json(self.sock)
+        if hello.get("streaming_predictor") != 1:
+            self.close()
+            raise ConnectionError(f"not a streaming predictor endpoint: {hello}")
+        self.row_shape = tuple(hello["row_shape"])
+        self.dtype = np.dtype(hello["dtype"])
+        self.max_batch = int(hello["max_batch"])
+        self.output_shape = tuple(hello["output_shape"])
+        self.output_dtype = np.dtype(hello.get("output_dtype", "<f4"))
+
+    def predict(self, batch: np.ndarray) -> np.ndarray:
+        batch = np.ascontiguousarray(np.asarray(batch, dtype=self.dtype))
+        if batch.shape[1:] != self.row_shape:
+            raise ValueError(f"rows of shape {batch.shape[1:]}, server expects {self.row_shape}")
+        if not 1 <= len(batch) <= self.max_batch:
+            raise ValueError(f"batch {len(batch)} outside 1..{self.max_batch}")
+        net.send_tensors(self.sock, net.ACTION_COMMIT, [batch])
+        payload = net.recv_frame(self.sock)
+        if payload[:1] == net.ACTION_WEIGHTS:
+            _, blobs = net.decode_tensors(payload)
+            flat = np.frombuffer(blobs[0], dtype=self.output_dtype)
+            return flat.reshape((len(batch),) + self.output_shape)
+        import json
+
+        err = json.loads(payload.decode("utf-8"))
+        raise RuntimeError(err.get("error", "streaming predict failed"))
+
+    def close(self) -> None:
+        try:
+            net.send_tensors(self.sock, net.ACTION_BYE, [])
+        except OSError:
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "StreamingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def stream_predict(host: str, port: int, events: Iterable[np.ndarray],
+                   micro_batch: int = 64) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Micro-batch an event stream through a predictor service.
+
+    The reference's Kafka-consumer loop shape: ``events`` yields single
+    feature rows; rows are grouped into ``micro_batch``-sized frames and
+    ``(rows, predictions)`` pairs are yielded as they return.  The final
+    partial batch is flushed at stream end.
+    """
+    with StreamingClient(host, port) as client:
+        buf: List[np.ndarray] = []
+        for row in events:
+            buf.append(np.asarray(row))
+            if len(buf) >= micro_batch:
+                rows = np.stack(buf)
+                yield rows, client.predict(rows)
+                buf = []
+        if buf:
+            rows = np.stack(buf)
+            yield rows, client.predict(rows)

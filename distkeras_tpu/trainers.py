@@ -126,7 +126,14 @@ class SingleTrainer(Trainer):
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None) -> Model:
         self.record_training_start()
-        epoch_fn = scan_epoch_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
+        # cached across train() calls: scan_epoch_fn returns a fresh jit
+        # closure each time, which would defeat the jit cache and recompile
+        # on every call (callers like the baseline runner call train() once
+        # per epoch to evaluate in between)
+        epoch_fn = getattr(self, "_epoch_fn", None)
+        if epoch_fn is None:
+            epoch_fn = scan_epoch_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
+            self._epoch_fn = epoch_fn
         # epoch_fn donates its (params, opt_state) buffers; work on a copy so
         # the caller's Model object stays valid
         params = jax.tree.map(jnp.array, self.model.params)

@@ -161,3 +161,74 @@ def test_async_trainers_learn(trainer_name, toy_dataset):
     acc = AccuracyEvaluator(prediction_col="prediction_index", label_col="label_index").evaluate(ds)
     assert acc > 0.9, f"{trainer_name} accuracy {acc}"
     assert len(trainer.history) > 0
+
+
+def test_async_checkpoint_snapshots_and_resume(toy_dataset, tmp_path):
+    """Async checkpoint story (round-1 weak #7): periodic center snapshots
+    + resume-from-latest-center."""
+    import numpy as np
+
+    from distkeras_tpu.checkpoint import Checkpointer
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.runtime.async_trainer import AsyncDOWNPOUR
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+    ck = Checkpointer(str(tmp_path / "async-ck"), keep=3)
+    t1 = AsyncDOWNPOUR(spec, num_workers=2, communication_window=2,
+                       batch_size=16, num_epoch=2, learning_rate=0.05,
+                       checkpoint_interval=0.2)
+    m1 = t1.train(toy_dataset, checkpointer=ck)
+    # at least the final snapshot exists, and it equals the returned center
+    assert ck.latest_step() is not None
+    restored = ck.restore({"params": m1.params})
+    for a, b in zip(jax_leaves(restored["params"]), jax_leaves(m1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # resume: a fresh trainer with the same checkpointer starts FROM the
+    # snapshot center, not from init
+    t2 = AsyncDOWNPOUR(spec, num_workers=2, communication_window=2,
+                       batch_size=16, num_epoch=1, seed=123)
+    assert t2._maybe_restore(ck) is True
+    for a, b in zip(jax_leaves(t2.model.params), jax_leaves(m1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # and training from the restored center still runs end to end
+    m2 = t2.train(toy_dataset, checkpointer=ck)
+    assert len(t2.history) > 0
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def test_fault_injection_continue_and_raise(toy_dataset):
+    """Failure-policy test (SURVEY §5 failure detection): a deterministically
+    killed worker either fails the run (default) or is tolerated while the
+    survivors finish ('continue')."""
+    import pytest as _pytest
+
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.runtime.async_trainer import AsyncDOWNPOUR
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+
+    def kill_worker_1(idx, window):
+        if idx == 1 and window == 1:
+            raise RuntimeError("injected fault: worker 1 dies at window 1")
+
+    common = dict(num_workers=2, communication_window=2, batch_size=16,
+                  num_epoch=2, learning_rate=0.05, fault_hook=kill_worker_1)
+
+    t = AsyncDOWNPOUR(spec, **common)
+    with _pytest.raises(RuntimeError, match="injected fault"):
+        t.train(toy_dataset)
+
+    t2 = AsyncDOWNPOUR(spec, on_worker_failure="continue", **common)
+    model = t2.train(toy_dataset)  # survivors finish, center returned
+    assert len(t2.worker_errors) == 1
+    assert "injected fault" in str(t2.worker_errors[0])
+    assert len(t2.history) > 0  # worker 0 trained through both epochs
+    assert model.predict(toy_dataset["features"][:8]).shape == (8, 2)
