@@ -1,7 +1,8 @@
 """Model zoo: registry-backed Flax architectures.
 
 Importing this package registers the built-in architectures (MLP, CNN,
-ResNet, TransformerLM) with the model registry used by serialization.
+ResNet, TransformerLM, MoE classifier) with the model registry used by
+serialization.
 """
 
 from distkeras_tpu.models.base import (  # noqa: F401
@@ -14,3 +15,6 @@ import distkeras_tpu.models.mlp  # noqa: F401
 import distkeras_tpu.models.cnn  # noqa: F401
 import distkeras_tpu.models.resnet  # noqa: F401
 import distkeras_tpu.models.transformer  # noqa: F401
+# the MoE classifier lives with its parallelism machinery but must register
+# here too, or cross-process deserialization can't rebuild it
+import distkeras_tpu.parallel.moe  # noqa: F401  (registers moe_mlp_classifier)

@@ -1,0 +1,245 @@
+"""Expert parallelism: Switch-style mixture-of-experts over an ``ep`` axis.
+
+No reference counterpart (data-parallel only, SURVEY §2.13) — this
+completes the framework's parallelism suite (dp/sp/tp/pp/ep).  The design
+is the standard TPU MoE shape (Switch Transformer / Mesh-TF lineage),
+built for the MXU and ICI:
+
+- **Top-1 routing with static capacity.**  Each token picks its best
+  expert; each expert accepts at most ``capacity`` tokens per shard (the
+  rest fall through on the residual path).  Everything is dense one-hot
+  einsums over static shapes — no gather/scatter, no dynamic shapes, so
+  XLA tiles all of it onto the MXU.
+- **Experts live sharded over ``ep``.**  Dispatch is two
+  ``lax.all_to_all``s over the mesh axis: token slots [E, C, D] travel to
+  the shard owning their expert, come back as expert outputs — the
+  all-to-all rides ICI, exactly like the sequence-parallel ring.
+- **Router determinism.**  Routing depends only on (params, tokens), so
+  ep=1 and ep=N produce bit-comparable results for the same inputs — the
+  parity property the tests pin down.
+
+The load-balancing auxiliary loss is the Switch one:
+``E * sum_e f_e * p_e`` (token fraction times mean router prob).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.base import ModelSpec, register_model
+
+import flax.linen as nn
+
+
+class MoEMLP(nn.Module):
+    """Router + E experts (each a 2-layer gelu MLP), top-1 dispatch.
+
+    Call with tokens [T, D] -> (out [T, D], aux_loss scalar).  ``ep_axis``
+    set (and bound by an enclosing shard_map) runs expert-parallel: this
+    shard computes routing for its T tokens, all_to_all's token slots so
+    each shard runs only its E_local = E/ep experts, and reverses the
+    exchange.  Unbound (init / single device): all experts local, same
+    math, no collectives.
+
+    Expert-parameter sharding follows the TP pattern (models/transformer.py):
+    init always builds the FULL tree (``ep_size=1`` semantics, w_up
+    [E, D, F]); the train step device_puts w_up/w_down with a leading-axis
+    ``P(ep)`` sharding and applies a module configured with ``ep_size=ep``,
+    whose declared param shapes are the LOCAL slabs [E/ep, D, F] — each
+    device holds (and optimizes) only its own experts' weights.  The
+    router stays replicated: routing needs all E logits.
+    """
+
+    num_experts: int
+    model_dim: int
+    hidden_dim: int
+    capacity: int  # per-expert slots PER SHARD
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        t, d = x.shape
+        if d != self.model_dim:
+            raise ValueError(f"tokens have dim {d}, module declares model_dim={self.model_dim}")
+        e, c, f = self.num_experts, self.capacity, self.hidden_dim
+        if e % self.ep_size:
+            raise ValueError(f"num_experts {e} not divisible by ep_size {self.ep_size}")
+        e_local = e // self.ep_size
+        router = self.param("router", nn.initializers.normal(0.02), (d, e))
+        w_up_l = self.param("w_up", nn.initializers.lecun_normal(), (e_local, d, f))
+        w_down_l = self.param("w_down", nn.initializers.lecun_normal(), (e_local, f, d))
+
+        xc = x.astype(self.compute_dtype)
+        # -- routing (float32 for a stable softmax/argmax) ---------------------
+        scores = jax.nn.softmax((x.astype(jnp.float32) @ router.astype(jnp.float32)),
+                                axis=-1)  # [T, E]
+        best = jnp.argmax(scores, axis=-1)                     # [T]
+        best_prob = jnp.max(scores, axis=-1)                   # [T]
+        onehot = jax.nn.one_hot(best, e, dtype=jnp.float32)    # [T, E]
+        # position of each token in its chosen expert's queue; beyond-capacity
+        # tokens are dropped (residual path, standard Switch behavior)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # [T, E]; -1 off-choice
+        pos_in_queue = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = pos_in_queue < c
+        slot = jax.nn.one_hot(jnp.where(keep, pos_in_queue, -1), c,
+                              dtype=jnp.float32)               # [T, C]; dropped -> all-zero
+        dispatch = onehot[:, :, None] * slot[:, None, :]       # [T, E, C]
+        combine = dispatch * best_prob[:, None, None]          # [T, E, C]
+
+        # Switch load-balance aux: E * sum_e (fraction routed) * (mean prob)
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(scores, axis=0)
+        aux = e * jnp.sum(frac * mean_prob)
+
+        # -- dispatch to experts ----------------------------------------------
+        slots = jnp.einsum("tec,td->ecd", dispatch.astype(self.compute_dtype), xc)
+        ep = 1
+        if self.ep_axis is not None and self.ep_axis in jax.typeof(x).vma:
+            ep = lax.axis_size(self.ep_axis)
+            if ep != self.ep_size:
+                raise ValueError(f"mesh axis {self.ep_axis!r} has size {ep}, module "
+                                 f"was configured with ep_size={self.ep_size}")
+        if ep > 1:
+            # tiled all_to_all: [E, C, D] -> [E_local, ep*C, D] — shard s
+            # keeps its E_local experts' slot block from EVERY peer (the
+            # expert dim splits, the slot dim concatenates); rides ICI
+            slots = lax.all_to_all(slots, self.ep_axis, split_axis=0, concat_axis=1,
+                                   tiled=True)
+
+        h = jnp.einsum("ecd,edf->ecf", slots, w_up_l.astype(self.compute_dtype))
+        h = nn.gelu(h)
+        out_slots = jnp.einsum("ecf,efd->ecd", h, w_down_l.astype(self.compute_dtype))
+
+        if ep > 1:
+            # reverse exchange: [E_local, ep*C, D] -> [E, C, D]
+            out_slots = lax.all_to_all(out_slots, self.ep_axis, split_axis=1,
+                                       concat_axis=0, tiled=True)
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(self.compute_dtype), out_slots)
+        return out.astype(x.dtype), aux
+
+
+@register_model("moe_mlp_classifier")
+class MoEClassifier(nn.Module):
+    """Small MoE classifier: embed -> MoE layer (+residual) -> head.
+
+    The minimal end-to-end carrier for expert parallelism (the MoE analogue
+    of the reference's MLP example family).
+    """
+
+    input_dim: int = 32
+    model_dim: int = 64
+    num_experts: int = 4
+    hidden_dim: int = 128
+    capacity: int = 64
+    num_outputs: int = 10
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.Dense(self.model_dim, name="embed")(x)
+        moe_out, aux = MoEMLP(num_experts=self.num_experts, model_dim=self.model_dim,
+                              hidden_dim=self.hidden_dim, capacity=self.capacity,
+                              ep_axis=self.ep_axis, ep_size=self.ep_size, name="moe")(h)
+        h = h + moe_out
+        self.sow("aux_loss", "load_balance", aux)
+        return nn.Dense(self.num_outputs, name="head")(h)
+
+
+def moe_classifier_spec(input_dim: int = 32, num_experts: int = 4, capacity: int = 64,
+                        num_outputs: int = 10, ep_axis: Optional[str] = None) -> ModelSpec:
+    return ModelSpec(
+        name="moe_mlp_classifier",
+        config={"input_dim": input_dim, "num_experts": num_experts,
+                "capacity": capacity, "num_outputs": num_outputs, "ep_axis": ep_axis},
+        input_shape=(input_dim,),
+    )
+
+
+def _moe_param_specs(params: Any, ep_axis: str):
+    """w_up/w_down leaves shard over ep on the leading (expert) axis; the
+    router and every non-MoE leaf stay replicated."""
+
+    def spec_for(path, _leaf):
+        names = {getattr(k, "key", None) for k in path}
+        return P(ep_axis) if names & {"w_up", "w_down"} else P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                        mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep",
+                        aux_weight: float = 0.01) -> Callable:
+    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)`` over
+    a (dp, ep) mesh: batch sharded over BOTH axes (every device works on its
+    own token shard), expert weights sharded over ep (each device holds and
+    optimizes only its own experts — place state with
+    ``moe_state_shardings``), everything else replicated.
+    """
+    from distkeras_tpu.models.base import build_module
+
+    ep = mesh.shape[ep_axis]
+    num_experts = spec.config["num_experts"]
+    if num_experts % ep:
+        raise ValueError(f"num_experts {num_experts} not divisible by "
+                         f"ep mesh axis size {ep}")
+    module_local = build_module(spec.name, dict(spec.config, ep_axis=ep_axis, ep_size=ep))
+
+    def shard_fn(params, opt_state, x, y):
+        def loss_fn(p):
+            logits, variables = module_local.apply(
+                {"params": p}, x, mutable=["aux_loss"])
+            ce = optax.softmax_cross_entropy(logits.astype(jnp.float32), y).mean()
+            aux = variables["aux_loss"]["load_balance"][0]
+            loss = ce + aux_weight * aux
+            n = lax.psum(1, (dp_axis, ep_axis))
+            return lax.psum(loss, (dp_axis, ep_axis)) / n
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # sync each grad leaf down to its param's sharding: replicated
+        # params need the cross-shard psum; expert slabs keep their ep
+        # variance but still sum over dp (the same slab serves every dp row)
+        grads = jax.tree.map(
+            lambda g, p: lax.psum(g, extra) if (extra := tuple(
+                a for a in jax.typeof(g).vma if a not in jax.typeof(p).vma)) else g,
+            grads, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def wrapped(params, opt_state, x, y):
+        # specs resolved at trace time from the actual tree structures
+        pspecs = _moe_param_specs(params, ep_axis)
+        ospecs = _moe_param_specs(opt_state, ep_axis)
+        data_spec = P((dp_axis, ep_axis))  # batch split over all devices
+        sharded = jax.shard_map(shard_fn, mesh=mesh,
+                                in_specs=(pspecs, ospecs, data_spec, data_spec),
+                                out_specs=(pspecs, ospecs, P()))
+        return sharded(params, opt_state, x, y)
+
+    return jax.jit(wrapped, donate_argnums=(0, 1))
+
+
+def moe_state_shardings(mesh: Mesh, optimizer: optax.GradientTransformation,
+                        params: Any, ep_axis: str = "ep"):
+    """(param shardings, opt-state shardings) for ``device_put`` before the
+    step: expert slabs over ep, the rest replicated (mirrors
+    ``lm_state_shardings`` for the tp path)."""
+    pspecs = _moe_param_specs(params, ep_axis)
+    ospecs = _moe_param_specs(jax.eval_shape(optimizer.init, params), ep_axis)
+    to_sh = lambda s: NamedSharding(mesh, s)
+    return (jax.tree.map(to_sh, pspecs, is_leaf=lambda x: isinstance(x, P)),
+            jax.tree.map(to_sh, ospecs, is_leaf=lambda x: isinstance(x, P)))
+
+
+def moe_data_sharding(mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep"):
+    return NamedSharding(mesh, P((dp_axis, ep_axis)))

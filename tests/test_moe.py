@@ -1,0 +1,124 @@
+"""Expert parallelism (Switch-style MoE over an ``ep`` mesh axis).
+
+No reference counterpart (SURVEY §2.13: data-parallel only) — these pin
+down the TPU-native guarantees: expert-parallel execution matches the
+single-device computation, capacity drops are deterministic, and the
+(dp x ep) train step learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.parallel.mesh import create_nd_mesh
+from distkeras_tpu.parallel.moe import (
+    MoEMLP, _moe_param_specs, make_moe_train_step, moe_classifier_spec,
+    moe_data_sharding, moe_state_shardings)
+
+T, D, E, F = 64, 16, 4, 32
+
+
+def _moe(capacity, ep_axis=None, ep_size=1):
+    return MoEMLP(num_experts=E, model_dim=D, hidden_dim=F, capacity=capacity,
+                  ep_axis=ep_axis, ep_size=ep_size, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tokens_and_params():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    params = _moe(capacity=T).init(jax.random.PRNGKey(0), x)["params"]
+    return x, params
+
+
+def test_expert_parallel_matches_single_device(tokens_and_params):
+    """ep=4 all_to_all dispatch + SHARDED expert weights == all-experts-local,
+    when nothing drops."""
+    x, params = tokens_and_params
+    ref, aux_ref = _moe(capacity=T).apply({"params": params}, x)
+
+    mesh = create_nd_mesh((4,), ("ep",))
+    # capacity is per shard; T >> T/4 so no drops
+    mod = _moe(capacity=T, ep_axis="ep", ep_size=4)
+    pspecs = _moe_param_specs(params, "ep")
+
+    def fn(params, x):
+        out, aux = mod.apply({"params": params}, x)
+        return out, jax.lax.psum(aux, "ep") / jax.lax.psum(1, "ep")
+
+    sharded = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(pspecs, P("ep")),
+                                    out_specs=(P("ep"), P())))
+    psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                       is_leaf=lambda v: isinstance(v, P))
+    out = sharded(jax.device_put(params, psh),
+                  jax.device_put(x, NamedSharding(mesh, P("ep"))))
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    # aux is per-shard token-fraction based; with tokens split evenly the
+    # mean of shard-auxes equals the global aux only when routing fractions
+    # match per shard — just require finiteness + same scale here
+    assert np.isfinite(float(out[1]))
+
+
+def test_capacity_drop_is_deterministic_residual():
+    """Tokens beyond an expert's capacity contribute exactly zero output."""
+    rng = np.random.default_rng(1)
+    # positive-sum rows so a large positive router column forces expert 0
+    x = jnp.asarray(rng.normal(size=(8, D)) + 2.0, dtype=jnp.float32)
+    mod = _moe(capacity=2)
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 1e3
+    params = dict(params, router=jnp.asarray(router))
+    out, aux = mod.apply({"params": params}, x)
+    out = np.asarray(out)
+    # first 2 tokens fill expert 0's queue; the rest are dropped -> zero rows
+    assert np.abs(out[:2]).sum() > 0
+    np.testing.assert_array_equal(out[2:], np.zeros_like(out[2:]))
+    # aux loss sees the imbalance: all mass on one expert -> ~E * 1 * p_0
+    assert float(aux) > 1.0
+
+
+def test_moe_train_step_learns_dp_ep():
+    mesh = create_nd_mesh((2, 2), ("dp", "ep"))
+    spec = moe_classifier_spec(input_dim=D, num_experts=E, capacity=32, num_outputs=4)
+    opt = optax.adam(3e-3)
+    step = make_moe_train_step(spec, opt, mesh)
+
+    rng = np.random.default_rng(2)
+    centers = rng.normal(scale=2.5, size=(4, D))
+    labels = rng.integers(0, 4, size=128)
+    x = (centers[labels] + rng.normal(scale=0.5, size=(128, D))).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[labels]
+
+    params = jax.tree.map(jnp.asarray, spec.init_params(seed=0))
+    psh, osh = moe_state_shardings(mesh, opt, params)
+    params = jax.device_put(params, psh)
+    opt_state = jax.device_put(opt.init(params), osh)
+    # expert slabs really are distributed: each device holds E/ep experts
+    w_up = params["moe"]["w_up"]
+    assert w_up.sharding.spec == P("ep")
+    assert w_up.addressable_shards[0].data.shape[0] == E // 2
+    dsh = moe_data_sharding(mesh)
+    xd, yd = jax.device_put(jnp.asarray(x), dsh), jax.device_put(jnp.asarray(y), dsh)
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, xd, yd)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_moe_classifier_spec_roundtrip_and_predict():
+    from distkeras_tpu.models.base import Model
+
+    spec = moe_classifier_spec(input_dim=D, num_experts=E, capacity=16, num_outputs=3)
+    m = Model.init(spec, seed=0)
+    x = np.random.default_rng(3).normal(size=(10, D)).astype(np.float32)
+    out = m.predict(x)
+    assert out.shape == (10, 3)
+    m2 = Model.deserialize(m.serialize())
+    np.testing.assert_array_equal(m2.predict(x), out)
