@@ -53,6 +53,8 @@ _EXPORTS = {
     "start_parameter_server": "distkeras_tpu.runtime.launcher",
     "Checkpointer": "distkeras_tpu.checkpoint",
     "Dataset": "distkeras_tpu.data.dataset",
+    "ColumnFile": "distkeras_tpu.data.colfile",
+    "write_columns": "distkeras_tpu.data.colfile",
     "Model": "distkeras_tpu.models.base",
     "ModelSpec": "distkeras_tpu.models.base",
     "ModelPredictor": "distkeras_tpu.predictors",

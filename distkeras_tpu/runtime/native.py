@@ -36,26 +36,68 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
-    """Compile the shared library if missing/stale. Returns an error string
-    on failure, None on success."""
-    if not os.path.exists(_SRC):
-        return f"native source not found: {_SRC}"
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+def build_shared(src: str, lib: str) -> Optional[str]:
+    """Compile ``src`` to the shared library ``lib`` if missing/stale.
+    Returns an error string on failure, None on success.  Shared by every
+    native component (PS hub, data loader)."""
+    if not os.path.exists(src):
+        return f"native source not found: {src}"
+    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
         return None
     # compile to a private temp path, then atomically rename into place:
     # a concurrent process either dlopens the complete old .so or the
     # complete new one, never a half-written file
-    tmp = f"{_LIB}.build-{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17", _SRC, "-o", tmp]
+    tmp = f"{lib}.build-{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17", src, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"g++ invocation failed: {e}"
     if proc.returncode != 0:
         return f"g++ failed:\n{proc.stderr}"
-    os.replace(tmp, _LIB)
+    os.replace(tmp, lib)
     return None
+
+
+def _build() -> Optional[str]:
+    return build_shared(_SRC, _LIB)
+
+
+class LazyNativeLib:
+    """Build-once/load-once native library with cached failure — the shared
+    state machine for every native component (PS hub, data loader, ...).
+
+    ``bind(lib)`` is called exactly once after a successful dlopen to set
+    restype/argtypes.  ``load()`` returns the CDLL or None; ``error()``
+    returns the cached build failure, if any.
+    """
+
+    def __init__(self, src: str, lib_path: str, bind):
+        self._src = src
+        self._lib_path = lib_path
+        self._bind = bind
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._error: Optional[str] = None
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            if self._error is not None:
+                return None
+            err = build_shared(self._src, self._lib_path)
+            if err is not None:
+                self._error = err
+                return None
+            lib = ctypes.CDLL(self._lib_path)
+            self._bind(lib)
+            self._lib = lib
+            return lib
+
+    def error(self) -> Optional[str]:
+        self.load()
+        return self._error
 
 
 def _load() -> Optional[ctypes.CDLL]:
