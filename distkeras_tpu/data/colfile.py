@@ -153,6 +153,11 @@ class ColumnFile:
             shape = tuple(lib.dk_dl_col_dim(handle, i, j)
                           for j in range(lib.dk_dl_col_ndim(handle, i)))
             nbytes = lib.dk_dl_col_nbytes(handle, i)
+            if any(d < 0 for d in shape) or \
+                    int(np.prod(shape, dtype=np.int64)) * dtype.itemsize != nbytes:
+                lib.dk_dl_release(handle)
+                raise OSError(f"corrupt DKCOL header: column {name!r} dims {shape} "
+                              f"disagree with nbytes {nbytes}")
             addr = lib.dk_dl_col_data(handle, i)
             buf = (ctypes.c_char * nbytes).from_address(addr)
             arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
@@ -161,21 +166,34 @@ class ColumnFile:
             self._col_index[name] = i
 
     def _open_fallback(self) -> None:
-        with open(self.path, "rb") as f:
-            if f.read(8) != MAGIC:
-                raise OSError(f"{self.path} is not a DKCOL1 container")
-            (ncols,) = struct.unpack("<I", f.read(4))
-            for i in range(ncols):
-                (nlen,) = struct.unpack("<I", f.read(4))
-                name = f.read(nlen).decode()
-                (dlen,) = struct.unpack("<I", f.read(4))
-                dtype = np.dtype(f.read(dlen).decode())
-                (ndim,) = struct.unpack("<I", f.read(4))
-                shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim))
-                off, nbytes = struct.unpack("<QQ", f.read(16))
-                self._cols[name] = np.memmap(self.path, dtype=dtype, mode="r",
-                                             offset=off, shape=tuple(shape))
-                self._col_index[name] = i
+        size = os.path.getsize(self.path)
+        try:
+            with open(self.path, "rb") as f:
+                if f.read(8) != MAGIC:
+                    raise OSError(f"{self.path} is not a DKCOL1 container")
+                (ncols,) = struct.unpack("<I", f.read(4))
+                if ncols > 4096:
+                    raise OSError("corrupt DKCOL header: column count")
+                for i in range(ncols):
+                    (nlen,) = struct.unpack("<I", f.read(4))
+                    name = f.read(nlen).decode()
+                    (dlen,) = struct.unpack("<I", f.read(4))
+                    dtype = np.dtype(f.read(dlen).decode())
+                    (ndim,) = struct.unpack("<I", f.read(4))
+                    shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim))
+                    off, nbytes = struct.unpack("<QQ", f.read(16))
+                    # same validation contract as the native loader
+                    if off > size or nbytes > size - off:
+                        raise OSError("corrupt DKCOL header: column out of bounds")
+                    if any(d < 0 for d in shape) or \
+                            int(np.prod(shape, dtype=np.int64)) * dtype.itemsize != nbytes:
+                        raise OSError("corrupt DKCOL header: dims/nbytes mismatch")
+                    self._cols[name] = np.memmap(self.path, dtype=dtype, mode="r",
+                                                 offset=off, shape=tuple(shape))
+                    self._col_index[name] = i
+        except (struct.error, UnicodeDecodeError, TypeError, ValueError,
+                OverflowError) as e:
+            raise OSError(f"corrupt DKCOL header: {e}") from None
 
     @property
     def columns(self) -> List[str]:
@@ -192,8 +210,8 @@ class ColumnFile:
     def prefetch(self, name: str, start_row: int, num_rows: int) -> None:
         """Advise the kernel to fault in rows [start, start+num) of a column
         (no-op on the fallback path — memmap still works, just lazily)."""
-        if not self.native:
-            return
+        if not self.native or self._handle is None:
+            return  # fallback, or closed: memmap/page cache still works lazily
         arr = self._cols[name]
         row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
         self._lib.dk_dl_prefetch(self._handle, self._col_index[name],

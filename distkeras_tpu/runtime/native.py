@@ -31,9 +31,6 @@ MODE_DELTA = 0   # center += d              (DOWNPOUR, elastic)
 MODE_ADAG = 1    # center += d/num_workers  (ADAG)
 MODE_DYNSGD = 2  # center += d/(staleness+1)
 
-_lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_error: Optional[str] = None
 
 
 def build_shared(src: str, lib: str) -> Optional[str]:
@@ -59,8 +56,6 @@ def build_shared(src: str, lib: str) -> Optional[str]:
     return None
 
 
-def _build() -> Optional[str]:
-    return build_shared(_SRC, _LIB)
 
 
 class LazyNativeLib:
@@ -100,33 +95,27 @@ class LazyNativeLib:
         return self._error
 
 
+def _bind_ps(lib: ctypes.CDLL) -> None:
+    lib.dk_ps_create.restype = ctypes.c_void_p
+    lib.dk_ps_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.dk_ps_start.restype = ctypes.c_int
+    lib.dk_ps_start.argtypes = [ctypes.c_void_p]
+    lib.dk_ps_stop.argtypes = [ctypes.c_void_p]
+    lib.dk_ps_get_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+    lib.dk_ps_set_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+    lib.dk_ps_num_updates.restype = ctypes.c_int64
+    lib.dk_ps_num_updates.argtypes = [ctypes.c_void_p]
+    lib.dk_ps_port.restype = ctypes.c_int
+    lib.dk_ps_port.argtypes = [ctypes.c_void_p]
+    lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
+
+
+_ps_lib = LazyNativeLib(_SRC, _LIB, _bind_ps)
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _build_error
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        if _build_error is not None:
-            return None
-        err = _build()
-        if err is not None:
-            _build_error = err
-            return None
-        lib = ctypes.CDLL(_LIB)
-        lib.dk_ps_create.restype = ctypes.c_void_p
-        lib.dk_ps_create.argtypes = [ctypes.c_int, ctypes.c_int,
-                                     ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
-        lib.dk_ps_start.restype = ctypes.c_int
-        lib.dk_ps_start.argtypes = [ctypes.c_void_p]
-        lib.dk_ps_stop.argtypes = [ctypes.c_void_p]
-        lib.dk_ps_get_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
-        lib.dk_ps_set_weights.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
-        lib.dk_ps_num_updates.restype = ctypes.c_int64
-        lib.dk_ps_num_updates.argtypes = [ctypes.c_void_p]
-        lib.dk_ps_port.restype = ctypes.c_int
-        lib.dk_ps_port.argtypes = [ctypes.c_void_p]
-        lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    return _ps_lib.load()
 
 
 def native_available() -> bool:
@@ -134,8 +123,7 @@ def native_available() -> bool:
 
 
 def build_error() -> Optional[str]:
-    _load()
-    return _build_error
+    return _ps_lib.error()
 
 
 class NativeParameterServer:
@@ -146,7 +134,7 @@ class NativeParameterServer:
                  num_workers: int = 1, port: int = 0):
         lib = _load()
         if lib is None:
-            raise RuntimeError(f"native PS unavailable: {_build_error}")
+            raise RuntimeError(f"native PS unavailable: {build_error()}")
         self._lib = lib
         self._templates = [np.array(w, dtype=np.float32) for w in weights]
         sizes = (ctypes.c_int64 * len(self._templates))(*[t.size for t in self._templates])

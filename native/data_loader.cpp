@@ -142,6 +142,8 @@ void* dk_dl_open(const char* path, int warm) {
     if (!read_exact(p, end, &ndim, 4) || ndim > 32) goto corrupt;
     c.dims.resize(ndim);
     if (!read_exact(p, end, c.dims.data(), 8 * ndim)) goto corrupt;
+    for (int64_t d : c.dims)
+      if (d < 0) goto corrupt;  // negative dims would let numpy infer shapes
     if (!read_exact(p, end, &c.offset, 8)) goto corrupt;
     if (!read_exact(p, end, &c.nbytes, 8)) goto corrupt;
     // overflow-safe bounds check: offset + nbytes could wrap in uint64
