@@ -284,9 +284,9 @@ def main() -> None:
             # secondary benches are TPU-only (flash is a Mosaic kernel) and
             # individually fallible — a failure is recorded, not fatal
             lm, attn = [], []
-            for seq, batch in ((2048, 8), (8192, 2)):
+            for seq, batch in ((2048, 8), (8192, 2), (32768, 1)):
                 try:
-                    lm.append(_bench_lm(seq, batch))
+                    lm.append(_bench_lm(seq, batch, steps=10 if seq < 32768 else 4))
                 except Exception as e:
                     lm.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
             for seq in (2048, 8192):

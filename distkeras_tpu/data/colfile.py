@@ -155,7 +155,9 @@ class ColumnFile:
             nbytes = lib.dk_dl_col_nbytes(handle, i)
             if any(d < 0 for d in shape) or \
                     int(np.prod(shape, dtype=np.int64)) * dtype.itemsize != nbytes:
-                lib.dk_dl_release(handle)
+                # no views escaped yet: a full close (munmap) is safe here,
+                # unlike the keep-mapped release used once views exist
+                lib.dk_dl_close(handle)
                 raise OSError(f"corrupt DKCOL header: column {name!r} dims {shape} "
                               f"disagree with nbytes {nbytes}")
             addr = lib.dk_dl_col_data(handle, i)
@@ -176,10 +178,16 @@ class ColumnFile:
                     raise OSError("corrupt DKCOL header: column count")
                 for i in range(ncols):
                     (nlen,) = struct.unpack("<I", f.read(4))
+                    if nlen > 4096:  # same caps as the native loader, so a
+                        raise OSError("corrupt DKCOL header: name length")
                     name = f.read(nlen).decode()
                     (dlen,) = struct.unpack("<I", f.read(4))
+                    if dlen > 64:  # flipped byte can't trigger a huge read
+                        raise OSError("corrupt DKCOL header: dtype length")
                     dtype = np.dtype(f.read(dlen).decode())
                     (ndim,) = struct.unpack("<I", f.read(4))
+                    if ndim > 32:
+                        raise OSError("corrupt DKCOL header: ndim")
                     shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim))
                     off, nbytes = struct.unpack("<QQ", f.read(16))
                     # same validation contract as the native loader

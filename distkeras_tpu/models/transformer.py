@@ -99,6 +99,9 @@ class TransformerLM(nn.Module):
     tp_axis: Optional[str] = None
     tp_size: int = 1
     attn_impl: Optional[str] = None
+    remat: bool = False  # rematerialize each block in the backward pass:
+                         # activation memory O(layers) -> O(1) blocks, the
+                         # standard FLOPs-for-HBM trade for long sequences
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     def setup(self):
@@ -144,14 +147,16 @@ class TransformerLM(nn.Module):
 
     def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
         x = self.embed_tokens(tokens, pos_offset)
+        run = (nn.remat(lambda m, y: m(y), prevent_cse=False)
+               if self.remat else (lambda m, y: m(y)))
         for blk in self.block:
-            x = blk(x)
+            x = run(blk, x)
         return self.head(x)
 
 
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
-                  tp_axis: Optional[str] = None):
+                  tp_axis: Optional[str] = None, remat: bool = False):
     from distkeras_tpu.models.base import ModelSpec
 
     return ModelSpec(
@@ -164,6 +169,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "max_seq_len": max_seq_len,
             "seq_axis": seq_axis,
             "tp_axis": tp_axis,
+            "remat": remat,
         },
         input_shape=(max_seq_len,),
         input_dtype="int32",

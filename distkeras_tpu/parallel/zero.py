@@ -56,6 +56,14 @@ def make_zero_train_step(spec: ModelSpec, loss: Callable,
     ``params`` replicated; ``opt_shard`` is this step's sharded optimizer
     state — create it with :func:`zero_init_state`, place it with
     :func:`zero_state_sharding`.  ``x``/``y`` batch-sharded over ``axis``.
+
+    .. warning:: ``optimizer`` must be ELEMENTWISE over parameters (sgd,
+       momentum, adam, adamw, rmsprop ...).  Transforms that couple
+       parameters globally — ``clip_by_global_norm``, LARS/LAMB trust
+       ratios — would compute their statistic over only the local 1/N
+       shard inside ``shard_map`` and silently diverge from replicated
+       DP.  Apply such transforms to the full gradient BEFORE this step
+       (or use the replicated trainers).
     """
     apply_fn = spec.apply_fn()
     n = mesh.shape[axis]

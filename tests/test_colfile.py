@@ -152,10 +152,17 @@ def test_split_rejected_on_mapped_dataset(colfile):
             cf.dataset().split(0.9, seed=0)
 
 
-@needs_native
-def test_corrupt_offset_overflow_rejected(tmp_path):
+@pytest.mark.parametrize("force_fallback", [False, True],
+                         ids=["native", "fallback"])
+def test_corrupt_offset_overflow_rejected(tmp_path, monkeypatch, force_fallback):
     import struct
 
+    if force_fallback:
+        import distkeras_tpu.data.colfile as cfm
+
+        monkeypatch.setattr(cfm, "_load_lib", lambda: None)
+    elif not native_loader_available():
+        pytest.skip("no C++ toolchain")
     # hand-craft a header whose offset+nbytes wraps uint64
     path = tmp_path / "evil.dkcol"
     name, dtype = b"x", b"<f4"
