@@ -50,12 +50,22 @@ class TransformerBlock(nn.Module):
     attn_impl: Optional[str] = None  # None=auto | "flash" (pallas) | "dense";
                                      # must stay None when seq_axis is set
                                      # (ring attention governs that path)
+    moe_experts: int = 0       # > 0 replaces the dense FFN with a Switch
+    moe_capacity: int = 0      # MoE layer (see parallel/moe.py); capacity
+    ep_axis: Optional[str] = None   # is per-expert slots per shard
+    ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         if self.num_heads % self.tp_size:
             raise ValueError(f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}")
+        if self.moe_experts and self.tp_size > 1:
+            raise ValueError("MoE FFN does not compose with tensor parallelism (v1); "
+                             "use either moe_experts or tp_size")
+        if self.moe_experts and self.seq_axis is not None:
+            raise ValueError("MoE FFN does not compose with sequence parallelism "
+                             "(v1); train MoE LMs with make_moe_lm_train_step")
         heads_local = self.num_heads // self.tp_size
         head_dim = self.model_dim // self.num_heads
         ffn_local = self.mlp_ratio * self.model_dim // self.tp_size
@@ -70,6 +80,21 @@ class TransformerBlock(nn.Module):
         x = x + _maybe_psum(o, self.tp_axis)
 
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        if self.moe_experts:
+            from distkeras_tpu.parallel.moe import MoEMLP
+
+            b, l, e = y.shape
+            # default capacity: factor-2 over the balanced share per expert
+            # (capacity T would make dispatch [T, E, T] — O(T^2) memory)
+            cap = self.moe_capacity or -(-2 * b * l // self.moe_experts)
+            moe_out, aux = MoEMLP(
+                num_experts=self.moe_experts, model_dim=self.model_dim,
+                hidden_dim=self.mlp_ratio * self.model_dim,
+                capacity=cap,
+                ep_axis=self.ep_axis, ep_size=self.ep_size,
+                compute_dtype=self.compute_dtype, name="moe")(y.reshape(b * l, e))
+            self.sow("aux_loss", "load_balance", aux)
+            return x + moe_out.reshape(b, l, e)
         y = nn.Dense(ffn_local, use_bias=False, dtype=self.compute_dtype, name="up")(y)
         y = nn.gelu(y)
         y = nn.Dense(self.model_dim, use_bias=False, dtype=self.compute_dtype, name="down")(y)
@@ -102,6 +127,10 @@ class TransformerLM(nn.Module):
     remat: bool = False  # rematerialize each block in the backward pass:
                          # activation memory O(layers) -> O(1) blocks, the
                          # standard FLOPs-for-HBM trade for long sequences
+    moe_experts: int = 0       # > 0: every block's FFN becomes a Switch MoE
+    moe_capacity: int = 0      # (0 capacity = no drops at init-batch size)
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     def setup(self):
@@ -123,6 +152,10 @@ class TransformerLM(nn.Module):
                 tp_axis=self.tp_axis,
                 tp_size=self.tp_size,
                 attn_impl=self.attn_impl,
+                moe_experts=self.moe_experts,
+                moe_capacity=self.moe_capacity,
+                ep_axis=self.ep_axis,
+                ep_size=self.ep_size,
                 compute_dtype=self.compute_dtype,
             )
             for _ in range(self.num_layers)
@@ -156,7 +189,8 @@ class TransformerLM(nn.Module):
 
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
-                  tp_axis: Optional[str] = None, remat: bool = False):
+                  tp_axis: Optional[str] = None, remat: bool = False,
+                  moe_experts: int = 0, moe_capacity: int = 0):
     from distkeras_tpu.models.base import ModelSpec
 
     return ModelSpec(
@@ -170,6 +204,8 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "seq_axis": seq_axis,
             "tp_axis": tp_axis,
             "remat": remat,
+            "moe_experts": moe_experts,
+            "moe_capacity": moe_capacity,
         },
         input_shape=(max_seq_len,),
         input_dtype="int32",

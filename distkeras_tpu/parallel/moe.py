@@ -177,19 +177,16 @@ def _moe_param_specs(params: Any, ep_axis: str):
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
-def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
-                        mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep",
-                        aux_weight: float = 0.01) -> Callable:
-    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)`` over
-    a (dp, ep) mesh: batch sharded over BOTH axes (every device works on its
-    own token shard), expert weights sharded over ep (each device holds and
-    optimizes only its own experts — place state with
-    ``moe_state_shardings``), everything else replicated.
-    """
+def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                   mesh: Mesh, dp_axis: str, ep_axis: str, aux_weight: float,
+                   num_experts: int, per_example_loss: Callable) -> Callable:
+    """Shared (dp x ep) step machinery: batch sharded over both axes,
+    expert weights sharded over ep, aux losses collected from every sown
+    ``aux_loss`` leaf, gradients synced per-leaf down to each param's
+    sharding."""
     from distkeras_tpu.models.base import build_module
 
     ep = mesh.shape[ep_axis]
-    num_experts = spec.config["num_experts"]
     if num_experts % ep:
         raise ValueError(f"num_experts {num_experts} not divisible by "
                          f"ep mesh axis size {ep}")
@@ -199,8 +196,9 @@ def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation
         def loss_fn(p):
             logits, variables = module_local.apply(
                 {"params": p}, x, mutable=["aux_loss"])
-            ce = optax.softmax_cross_entropy(logits.astype(jnp.float32), y).mean()
-            aux = variables["aux_loss"]["load_balance"][0]
+            ce = per_example_loss(logits, y)
+            aux_leaves = jax.tree.leaves(variables.get("aux_loss", {}))
+            aux = sum(aux_leaves) / len(aux_leaves) if aux_leaves else 0.0
             loss = ce + aux_weight * aux
             n = lax.psum(1, (dp_axis, ep_axis))
             return lax.psum(loss, (dp_axis, ep_axis)) / n
@@ -227,6 +225,37 @@ def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation
         return sharded(params, opt_state, x, y)
 
     return jax.jit(wrapped, donate_argnums=(0, 1))
+
+
+def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                        mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep",
+                        aux_weight: float = 0.01) -> Callable:
+    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)`` over
+    a (dp, ep) mesh for classifier-shaped models: ``y`` one-hot.  Expert
+    weights sharded over ep (place state with ``moe_state_shardings``),
+    everything else replicated.
+    """
+    return _make_moe_step(
+        spec, optimizer, mesh, dp_axis, ep_axis, aux_weight,
+        num_experts=spec.config["num_experts"],
+        per_example_loss=lambda logits, y: optax.softmax_cross_entropy(
+            logits.astype(jnp.float32), y).mean())
+
+
+def make_moe_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
+                           mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep",
+                           aux_weight: float = 0.01) -> Callable:
+    """(dp x ep) training step for a MoE TransformerLM (``moe_experts`` set
+    in the spec): tokens/targets [B, L] int32 with B sharded over both
+    axes, Switch FFN experts sharded over ep, per-block load-balance aux
+    losses averaged into the objective.  v1 scope: MoE composes with dp/ep
+    here (tp/sp belong to the dense lm step in parallel/lm.py).
+    """
+    return _make_moe_step(
+        spec, optimizer, mesh, dp_axis, ep_axis, aux_weight,
+        num_experts=spec.config["moe_experts"],
+        per_example_loss=lambda logits, tgt: optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgt.astype(jnp.int32))[:, :-1].mean())
 
 
 def moe_state_shardings(mesh: Mesh, optimizer: optax.GradientTransformation,
