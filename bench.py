@@ -74,32 +74,58 @@ def _init_backend(retries: int = 3, wait_s: float = 10.0):
     return jax.default_backend(), f"{type(last).__name__}: {last}"
 
 
-def _bench_mnist_cnn(batch_size: int = 512, num_batches: int = 100, reps: int = 3):
+# v5e sweet spot from the 2026-07-30 in-program sweep (see _bench_mnist_cnn);
+# the single source for both the bench config and the reported metadata
+_MNIST_BATCH = 1024
+
+# bump whenever the headline measurement itself changes (batch size, dispatch
+# structure, ...); vs_baseline is only computed against a matching tag
+_METHODOLOGY = "in-program-multi-epoch-v2"
+
+
+def _bench_mnist_cnn(batch_size: int = _MNIST_BATCH, num_batches: int = 200, reps: int = 3,
+                     repeat: int = 3):
     """Headline number: MNIST-CNN scan-epoch training throughput.
 
-    batch 512 is the measured v5e throughput peak for this model (sweep
-    2026-07-30: 256->382k, 512->408k, 1024->341k samples/sec; bf16 compute
+    All ``reps`` epochs run inside ONE compiled program (outer lax.scan over
+    the inner per-batch scan): on the relayed axon platform each dispatch
+    costs ~50-100ms of RPC latency, and the round-1 bench (one dispatch per
+    epoch, host sync between) measured that latency, not the chip — moving
+    the loop in-program took the same model from ~400k to ~1M samples/sec.
+    batch 1024 is the measured v5e sweet spot (sweep 2026-07-30, in-program:
+    512->765k, 1024->999k, 2048->565k, 4096->520k samples/sec; bf16 compute
     measured SLOWER than f32 here — the convs are too small to feed the
-    MXU, so the layout conversions dominate)."""
+    MXU, so the layout conversions dominate).  Median of ``repeat`` timed
+    runs so one contended run doesn't set the record."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
+    from jax import lax
 
     from distkeras_tpu.models.base import Model
     from distkeras_tpu.models.cnn import mnist_cnn_spec
     from distkeras_tpu.ops.losses import get_loss
-    from distkeras_tpu.parallel.engine import scan_epoch_fn
+    from distkeras_tpu.parallel.engine import make_minibatch_step
 
     spec = mnist_cnn_spec()
     model = Model.init(spec, seed=0)
     optimizer = optax.sgd(0.01, momentum=0.9)
-    epoch_fn = scan_epoch_fn(spec.apply_fn(), get_loss("categorical_crossentropy"), optimizer)
+    mini = make_minibatch_step(spec.apply_fn(), get_loss("categorical_crossentropy"), optimizer)
+
+    @jax.jit
+    def multi_epoch(params, opt_state, xs, ys):
+        def epoch(carry, _):
+            carry, losses = lax.scan(mini, carry, (xs, ys))
+            return carry, losses[-1]
+
+        (params, opt_state), last = lax.scan(
+            epoch, (params, opt_state), None, length=reps)
+        return params, opt_state, last
 
     rng = np.random.default_rng(0)
-    xs = rng.normal(size=(num_batches, batch_size, 28, 28, 1)).astype(np.float32)
-    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=(num_batches, batch_size))]
-    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+    xs_d = jnp.asarray(rng.normal(size=(num_batches, batch_size, 28, 28, 1)).astype(np.float32))
+    ys_d = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=(num_batches, batch_size))])
 
     params = jax.tree.map(jnp.array, model.params)
     opt_state = optimizer.init(params)
@@ -107,17 +133,17 @@ def _bench_mnist_cnn(batch_size: int = 512, num_batches: int = 100, reps: int = 
     # warmup (compile + one full pass); host readback is the only reliable
     # completion barrier on relayed/remote platforms, where
     # block_until_ready can return before execution finishes
-    params, opt_state, losses = epoch_fn(params, opt_state, xs_d, ys_d)
-    np.asarray(losses)
-
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        params, opt_state, losses = epoch_fn(params, opt_state, xs_d, ys_d)
-        np.asarray(losses)
-    dt = time.perf_counter() - t0
+    _, _, last = multi_epoch(params, opt_state, xs_d, ys_d)
+    np.asarray(last)
 
     samples = reps * num_batches * batch_size
-    return samples / dt / jax.device_count()
+    rates = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        _, _, last = multi_epoch(params, opt_state, xs_d, ys_d)
+        np.asarray(last)
+        rates.append(samples / (time.perf_counter() - t0))
+    return sorted(rates)[len(rates) // 2] / jax.device_count()
 
 
 def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int = 8,
@@ -195,8 +221,12 @@ def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int 
 
 
 def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int = 64,
-                steps: int = 5):
-    """Kernel microbench: Pallas flash vs XLA dense attention, fwd+bwd."""
+                steps: int = 50):
+    """Kernel microbench: Pallas flash vs XLA dense attention, fwd+bwd.
+
+    ``steps`` must be large enough to amortize the one-dispatch RPC cost of
+    the relayed axon platform (~50-100ms): at steps=5 the 2k-token per-step
+    figure read ~25ms when the kernel actually takes ~3.3ms."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -261,7 +291,8 @@ def main() -> None:
 
         sps_per_chip = _bench_mnist_cnn()
         out["value"] = round(sps_per_chip, 1)
-        out["batch_size"] = 512
+        out["batch_size"] = _MNIST_BATCH
+        out["methodology"] = _METHODOLOGY
 
         baseline_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
@@ -270,12 +301,20 @@ def main() -> None:
             with open(baseline_path) as f:
                 baseline = json.load(f)
             base = baseline.get("value")
+            base_method = baseline.get("methodology")
             if base and baseline.get("platform", "tpu") != platform:
                 # CPU-fallback throughput vs a TPU baseline is meaningless;
                 # skip the ratio (keep 1.0) and flag why
                 out["vs_baseline_note"] = (
                     f"baseline recorded on {baseline.get('platform', 'tpu')}; "
                     f"this run on {platform} — ratio not computed")
+            elif base and base_method != _METHODOLOGY:
+                # a ratio across bench-methodology changes measures the
+                # measurement, not the chip (the round-2 dispatch-overhead
+                # fix alone moved the same model 539k -> 934k)
+                out["vs_baseline_note"] = (
+                    f"baseline methodology {base_method!r} != {_METHODOLOGY!r}"
+                    " — ratio not computed")
             elif base:
                 vs = sps_per_chip / base
         out["vs_baseline"] = round(vs, 6)
@@ -284,14 +323,19 @@ def main() -> None:
             # secondary benches are TPU-only (flash is a Mosaic kernel) and
             # individually fallible — a failure is recorded, not fatal
             lm, attn = [], []
-            for seq, batch in ((2048, 8), (8192, 2), (32768, 1)):
+            # steps sized so per-step relay overhead (~100ms/dispatch) stays
+            # under ~3% of the reported ms_per_step at each length
+            # 32768 stays at 4 steps: a 6-step run inside the full bench once
+            # blew up to 24s/step (HBM pressure after the earlier legs); at
+            # ~960ms/step the dispatch overhead is <3% anyway
+            for seq, batch, steps in ((2048, 8, 40), (8192, 2, 20), (32768, 1, 4)):
                 try:
-                    lm.append(_bench_lm(seq, batch, steps=10 if seq < 32768 else 4))
+                    lm.append(_bench_lm(seq, batch, steps=steps))
                 except Exception as e:
                     lm.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
-            for seq in (2048, 8192):
+            for seq, steps in ((2048, 50), (8192, 25)):
                 try:
-                    attn.append(_bench_attn(seq))
+                    attn.append(_bench_attn(seq, steps=steps))
                 except Exception as e:
                     attn.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
             out["lm"] = lm

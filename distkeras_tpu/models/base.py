@@ -82,6 +82,19 @@ class ModelSpec:
 
         return apply
 
+    def reject_silent_aux(self, where: str) -> None:
+        """Raise if training this spec through a plain ``apply_fn`` step
+        would silently drop sown aux losses (``sow`` into an immutable
+        collection is a no-op): currently MoE load-balance losses —
+        ``moe_experts`` on transformer_lm specs, ``num_experts`` on
+        moe_mlp_classifier specs."""
+        if self.config.get("moe_experts") or self.config.get("num_experts"):
+            raise ValueError(
+                f"{where} would silently drop the MoE load-balance aux losses "
+                "(sow into an immutable collection is a no-op); train MoE "
+                "models with parallel/moe.py :: make_moe_train_step / "
+                "make_moe_lm_train_step")
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "name": self.name,

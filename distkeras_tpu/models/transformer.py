@@ -128,7 +128,9 @@ class TransformerLM(nn.Module):
                          # activation memory O(layers) -> O(1) blocks, the
                          # standard FLOPs-for-HBM trade for long sequences
     moe_experts: int = 0       # > 0: every block's FFN becomes a Switch MoE
-    moe_capacity: int = 0      # (0 capacity = no drops at init-batch size)
+    moe_capacity: int = 0      # (0 = default to 2x the balanced share per
+                               # expert; imbalanced routing beyond that
+                               # still drops tokens to the residual path)
     ep_axis: Optional[str] = None
     ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16

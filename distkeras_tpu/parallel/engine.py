@@ -99,6 +99,7 @@ class WindowEngine:
     def __init__(self, spec: ModelSpec, loss: Callable,
                  optimizer: optax.GradientTransformation, algorithm: Algorithm,
                  mesh: Mesh, axis_name: str = "replica", window: int = 1):
+        spec.reject_silent_aux("WindowEngine")
         self.spec = spec
         self.loss = loss
         self.optimizer = optimizer

@@ -100,11 +100,7 @@ def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
     (or absent from the mesh) disables sequence parallelism; the spec's
     ``seq_axis`` must agree.
     """
-    if spec.config.get("moe_experts"):
-        raise ValueError(
-            "this dense LM step would silently drop the MoE load-balance aux "
-            "losses (sow into an immutable collection is a no-op); train MoE "
-            "LMs with parallel/moe.py :: make_moe_lm_train_step")
+    spec.reject_silent_aux("make_lm_train_step")
     sp_active = sp_axis is not None and sp_axis in mesh.shape and mesh.shape[sp_axis] > 1
     if sp_active and spec.config.get("seq_axis") != sp_axis:
         raise ValueError(

@@ -78,6 +78,9 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
     multiple of ``num_microbatches`` per dp shard); block params must be
     placed with ``pp_state_shardings``.
     """
+    if spec.config.get("moe_experts"):
+        raise ValueError("MoE FFN does not compose with pipeline parallelism "
+                         "(v1); use make_moe_lm_train_step or a dense spec")
     pp = mesh.shape[pp_axis]
     num_layers = spec.config["num_layers"]
     if num_layers % pp:

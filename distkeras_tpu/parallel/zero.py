@@ -65,6 +65,7 @@ def make_zero_train_step(spec: ModelSpec, loss: Callable,
        DP.  Apply such transforms to the full gradient BEFORE this step
        (or use the replicated trainers).
     """
+    spec.reject_silent_aux("make_zero_train_step")
     apply_fn = spec.apply_fn()
     n = mesh.shape[axis]
     template = jax.eval_shape(lambda: spec.init_params(seed=0))

@@ -51,6 +51,7 @@ class Trainer:
                  profile_dir: Optional[str] = None):
         if isinstance(model, ModelSpec):
             model = Model.init(model, seed=seed)
+        model.spec.reject_silent_aux(type(self).__name__)
         self.model = model
         self.loss = get_loss(loss)
         self.optimizer = get_optimizer(worker_optimizer, learning_rate=learning_rate, momentum=momentum)

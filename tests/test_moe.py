@@ -195,3 +195,36 @@ def test_dense_lm_step_rejects_moe_spec():
     with pytest.raises(ValueError, match="make_moe_lm_train_step"):
         make_lm_train_step(spec, _optax.sgd(0.01), _mesh((2,), ("dp",)),
                            sp_axis=None)
+
+
+def test_generic_training_paths_reject_moe_spec():
+    """Every spec-aware training entry that would run the plain apply_fn —
+    the trainer family, the ZeRO step, the window engine, the pp step —
+    must refuse MoE specs the same way the dense LM step does (a silent
+    sow no-op would train with zero load-balance loss)."""
+    import optax as _optax
+
+    from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.parallel.algorithms import AdagAlgorithm
+    from distkeras_tpu.parallel.engine import WindowEngine
+    from distkeras_tpu.parallel.mesh import create_nd_mesh as _mesh
+    from distkeras_tpu.parallel.pipeline import make_pp_train_step
+    from distkeras_tpu.parallel.zero import make_zero_train_step
+    from distkeras_tpu.trainers import SingleTrainer
+
+    spec = small_lm_spec(vocab_size=64, model_dim=32, num_heads=2,
+                         num_layers=2, max_seq_len=16, moe_experts=4)
+    loss = get_loss("categorical_crossentropy")
+    mesh = _mesh((2,), ("replica",))
+    with pytest.raises(ValueError, match="make_moe_lm_train_step"):
+        SingleTrainer(spec)
+    with pytest.raises(ValueError, match="make_moe_lm_train_step"):
+        make_zero_train_step(spec, loss, _optax.sgd(0.01), mesh)
+    with pytest.raises(ValueError, match="make_moe_lm_train_step"):
+        WindowEngine(spec, loss, _optax.sgd(0.01), AdagAlgorithm(), mesh)
+    from distkeras_tpu.parallel.moe import moe_classifier_spec
+    with pytest.raises(ValueError, match="make_moe_train_step"):
+        SingleTrainer(moe_classifier_spec())
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        make_pp_train_step(spec, _optax.sgd(0.01), _mesh((2,), ("pp",)), num_microbatches=2)
