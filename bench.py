@@ -276,6 +276,43 @@ def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int =
     }
 
 
+def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256,
+                  model_dim: int = 512, num_heads: int = 8, num_layers: int = 8,
+                  vocab: int = 8192):
+    """KV-cache autoregressive decode throughput (greedy), tokens/sec.
+
+    The whole generation (prefill + ``new_tokens`` scanned single-token
+    steps) is one compiled program, so the relay dispatch cost amortizes
+    over the full sequence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.decode import make_generate_fn
+    from distkeras_tpu.models.transformer import small_lm_spec
+
+    spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim, num_heads=num_heads,
+                         num_layers=num_layers, max_seq_len=prompt_len + new_tokens)
+    model = Model.init(spec, seed=0)
+    fn = make_generate_fn(spec, new_tokens)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    np.asarray(fn(model.params, prompt, key))  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(fn(model.params, prompt, key))
+    dt = time.perf_counter() - t0
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "tokens_per_sec": round(batch * new_tokens / dt, 1),
+        "ms_per_token": round(dt / new_tokens * 1e3, 3),
+    }
+
+
 def main() -> None:
     out = {
         "metric": "mnist_cnn_train_samples_per_sec_per_chip",
@@ -340,6 +377,10 @@ def main() -> None:
                     attn.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
             out["lm"] = lm
             out["attn"] = attn
+            try:
+                out["decode"] = _bench_decode()
+            except Exception as e:
+                out["decode"] = {"error": f"{type(e).__name__}: {e}"}
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,
         out["vs_baseline"] = 0.0  # even if a sub-step already set a value

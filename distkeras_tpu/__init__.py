@@ -57,6 +57,8 @@ _EXPORTS = {
     "write_columns": "distkeras_tpu.data.colfile",
     "Model": "distkeras_tpu.models.base",
     "ModelSpec": "distkeras_tpu.models.base",
+    "generate": "distkeras_tpu.models.decode",
+    "make_generate_fn": "distkeras_tpu.models.decode",
     "ModelPredictor": "distkeras_tpu.predictors",
     "AccuracyEvaluator": "distkeras_tpu.evaluators",
     "pin_cpu_devices": "distkeras_tpu.platform",

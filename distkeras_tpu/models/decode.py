@@ -1,0 +1,228 @@
+"""KV-cache autoregressive decoding for ``TransformerLM``.
+
+The reference has a streaming-inference story only as a Spark+Kafka
+pipeline of independent ``model.predict`` calls (SURVEY.md §2.21); for the
+flagship LM family the TPU-native equivalent is real incremental decoding:
+a compiled prefill that ingests the whole prompt in one MXU-shaped pass and
+a compiled per-token step that attends against an in-HBM KV cache instead
+of re-running the full sequence (O(L) per token instead of O(L²)).
+
+Implementation notes:
+
+- Pure functions over the published param tree (``embed``, ``pos_embed``,
+  ``block_{i}.{LayerNorm_0,qkv,proj,LayerNorm_1,up,down}``, ``final_norm``)
+  rather than a Flax method: a compact Flax module allows only one
+  ``nn.compact`` method, and threading a mutable cache collection through
+  ``module.apply`` would force the training path to carry decode-only
+  plumbing.  Parity with ``TransformerLM.__call__`` is enforced by test
+  (``tests/test_decode.py``), not by code sharing.
+- One attention routine serves prefill (L = prompt) and decode (L = 1):
+  new K/V rows are written into the cache at ``start_pos`` with
+  ``lax.dynamic_update_slice`` and queries attend over the full cache
+  under the mask ``key_pos <= start_pos + query_offset`` — dead cache rows
+  are masked, so the cache can be any length >= the generated sequence.
+- Static shapes throughout: the generation loop is a ``lax.scan`` of
+  single-token steps over a fixed ``max_new_tokens``; finished rows (past
+  EOS) keep emitting ``pad_id`` under a carried ``done`` flag instead of
+  breaking out, which is the compiler-friendly form of early exit.
+- The KV cache is [num_layers, B, cache_len, H, Dh] in the compute dtype
+  (bfloat16 by default) — the decode-time HBM working set — and attention
+  logits/softmax run in float32 like the training path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.base import Model, ModelSpec
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer key/value cache: [num_layers, B, S, H, Dh]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+
+def _cfg_dtype(config: dict) -> Any:
+    return config.get("compute_dtype", jnp.bfloat16)
+
+
+def _layer_norm(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """flax.linen.LayerNorm semantics: stats in float32, eps 1e-6."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = jnp.square(xf - mu).mean(-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + 1e-6)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def _block(pb: dict, x: jnp.ndarray, k_all: jnp.ndarray, v_all: jnp.ndarray,
+           layer: int, start_pos, dtype) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block over ``x`` [B, L, E] with KV caching.
+
+    ``k_all``/``v_all`` are the STACKED [layers, B, S, H, Dh] caches; only
+    the L new K/V rows of layer ``layer`` are written (in place when XLA
+    can alias the scan carry — the whole point: rewriting the full cache
+    per decoded token would move ~50MB/token at bench size).  Queries
+    attend over the layer's slab masked to ``key_pos <= start_pos +
+    query_offset``, which also masks dead rows beyond the write head.
+    """
+    head_dim = k_all.shape[-1]
+
+    y = _layer_norm(pb["LayerNorm_0"], x, dtype)
+    qkv = jnp.einsum("ble,eshd->blshd", y, pb["qkv"]["kernel"].astype(dtype))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    k_all = lax.dynamic_update_slice(
+        k_all, k.astype(k_all.dtype)[None], (layer, 0, start_pos, 0, 0))
+    v_all = lax.dynamic_update_slice(
+        v_all, v.astype(v_all.dtype)[None], (layer, 0, start_pos, 0, 0))
+    ck, cv = k_all[layer], v_all[layer]
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / head_dim ** 0.5)
+    q_pos = start_pos + lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    scores = jnp.where(k_pos <= q_pos, scores, float("-inf"))
+    attn = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, cv)
+    o = jnp.einsum("bqhd,hde->bqe", o, pb["proj"]["kernel"].astype(dtype))
+    x = x + o
+
+    y = _layer_norm(pb["LayerNorm_1"], x, dtype)
+    y = jax.nn.gelu(jnp.einsum("ble,ef->blf", y, pb["up"]["kernel"].astype(dtype)))
+    y = jnp.einsum("blf,fe->ble", y, pb["down"]["kernel"].astype(dtype))
+    return x + y, k_all, v_all
+
+
+def init_cache(config: dict, batch: int, cache_len: int) -> KVCache:
+    """Zero cache sized for ``cache_len`` total positions (prompt + new)."""
+    n_layers = config["num_layers"]
+    heads = config["num_heads"]
+    head_dim = config["model_dim"] // heads
+    shape = (n_layers, batch, cache_len, heads, head_dim)
+    dtype = _cfg_dtype(config)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
+                       start_pos, cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+    """Run tokens [B, L] at positions ``start_pos..start_pos+L-1`` against
+    the cache; returns (float32 logits [B, L, vocab], updated cache).
+
+    Serves both phases: prefill (L = prompt length, start_pos = 0) and
+    decode (L = 1, start_pos = current length).
+    """
+    dtype = _cfg_dtype(config)
+    n_layers = config["num_layers"]
+    x = params["embed"]["embedding"].astype(dtype)[tokens]
+    pos = start_pos + jnp.arange(tokens.shape[1])
+    x = x + params["pos_embed"][pos].astype(dtype)
+
+    k_all, v_all = cache.k, cache.v
+    for i in range(n_layers):
+        x, k_all, v_all = _block(params[f"block_{i}"], x, k_all, v_all, i,
+                                 start_pos, dtype)
+
+    x = _layer_norm(params["final_norm"], x, dtype)
+    logits = jnp.einsum("ble,ve->blv", x.astype(jnp.float32),
+                        params["embed"]["embedding"].astype(jnp.float32))
+    return logits, KVCache(k_all, v_all)
+
+
+def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int) -> jnp.ndarray:
+    """[B, vocab] float32 logits -> [B] int32 token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, float("-inf"), logits)
+    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
+                     temperature: float = 0.0, top_k: int = 0,
+                     eos_id: Optional[int] = None, pad_id: int = 0,
+                     cache_len: Optional[int] = None):
+    """Build a jitted ``(params, prompt [B, P], rng) -> tokens [B, max_new]``.
+
+    ``cache_len`` defaults to prompt length + ``max_new_tokens`` (it is a
+    static shape, so the returned fn recompiles per distinct prompt length,
+    like any jitted shape-polymorphic JAX program).  Greedy when
+    ``temperature == 0``.  Rows that have emitted ``eos_id`` keep emitting
+    ``pad_id``.
+    """
+    config = dict(spec.config)
+    if config.get("seq_axis") or config.get("tp_axis"):
+        raise ValueError("decoding expects a plain (non-sharded) spec; strip "
+                         "seq_axis/tp_axis — the cache math is single-program")
+    if config.get("moe_experts"):
+        raise ValueError("KV-cache decoding does not support MoE specs (v1)")
+    if spec.name != "transformer_lm":
+        raise ValueError(f"decoding is defined for transformer_lm specs, got {spec.name!r}")
+    max_seq = config["max_seq_len"]
+
+    @functools.partial(jax.jit, static_argnames=("prompt_len",))
+    def run(params, prompt, rng, prompt_len):
+        total = cache_len or (prompt_len + max_new_tokens)
+        if prompt_len + max_new_tokens > total:
+            raise ValueError(
+                f"cache_len = {total} cannot hold prompt ({prompt_len}) + "
+                f"max_new_tokens ({max_new_tokens}); out-of-range cache "
+                "writes would silently clamp and corrupt generation")
+        if total > max_seq:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the positional "
+                f"table max_seq_len = {max_seq}")
+        cache = init_cache(config, prompt.shape[0], total)
+        logits, cache = forward_with_cache(params, config, prompt, 0, cache)
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature, top_k)
+        # the EOS token itself is kept in the output; rows are padded after
+        done = jnp.zeros(prompt.shape[0], bool) if eos_id is None else tok == eos_id
+
+        def step(carry, _):
+            tok, cache, pos, rng, done = carry
+            logits, cache = forward_with_cache(
+                params, config, tok[:, None], pos, cache)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            if eos_id is not None:
+                nxt = jnp.where(done, pad_id, nxt)
+                done = done | (nxt == eos_id)
+            return (nxt, cache, pos + 1, rng, done), nxt
+
+        carry = (tok, cache, jnp.asarray(prompt_len, jnp.int32), rng, done)
+        if max_new_tokens > 1:
+            (_, _, _, _, _), rest = lax.scan(step, carry, None,
+                                             length=max_new_tokens - 1)
+            return jnp.concatenate([tok[:, None], rest.T], axis=1)
+        return tok[:, None]
+
+    def generate_fn(params, prompt, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return run(params, prompt, rng, prompt.shape[1])
+
+    return generate_fn
+
+
+def generate(model: Model, prompt: jnp.ndarray, max_new_tokens: int,
+             *, temperature: float = 0.0, top_k: int = 0,
+             eos_id: Optional[int] = None, pad_id: int = 0,
+             seed: int = 0) -> jnp.ndarray:
+    """Convenience one-shot: generate ``max_new_tokens`` continuations of
+    ``prompt`` [B, P] from a trained ``Model``; returns [B, max_new_tokens].
+
+    For repeated generation build the fn once with :func:`make_generate_fn`
+    (this wrapper rebuilds — and therefore recompiles — every call).
+    """
+    fn = make_generate_fn(model.spec, max_new_tokens, temperature=temperature,
+                          top_k=top_k, eos_id=eos_id, pad_id=pad_id)
+    return fn(model.params, jnp.asarray(prompt), jax.random.PRNGKey(seed))

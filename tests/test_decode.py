@@ -1,0 +1,115 @@
+"""KV-cache decoding: parity with the training-path forward and the
+semantics of generation (greedy, EOS padding, sampling, guards)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.models.decode import (KVCache, forward_with_cache,
+                                         generate, init_cache,
+                                         make_generate_fn)
+from distkeras_tpu.models.transformer import small_lm_spec
+
+
+def _spec(**kw):
+    # float32 compute so parity tolerances are tight (bf16 would add
+    # rounding noise between the einsum and flax Dense formulations)
+    cfg = dict(vocab_size=61, model_dim=32, num_heads=2, num_layers=2,
+               max_seq_len=32)
+    cfg.update(kw)
+    spec = small_lm_spec(**cfg)
+    spec.config["compute_dtype"] = "float32"
+    return spec
+
+
+@pytest.fixture(scope="module")
+def model():
+    return Model.init(_spec(), seed=0)
+
+
+def test_prefill_logits_match_training_forward(model):
+    """forward_with_cache at start_pos=0 must reproduce the Flax module's
+    logits exactly (same math, different formulation)."""
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 61, (2, 9)))
+    want = model.apply(toks)
+    cache = init_cache(model.spec.config, 2, 16)
+    got, cache2 = forward_with_cache(model.params, model.spec.config, toks, 0, cache)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # the cache rows beyond the prompt stay zero (dead until written)
+    assert np.all(np.asarray(cache2.k[:, :, 9:]) == 0)
+
+
+def test_incremental_decode_matches_full_forward(model):
+    """Feeding tokens one at a time through the cache must give the same
+    last-position logits as re-running the full prefix each time."""
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(0, 61, (1, 8)))
+    cache = init_cache(model.spec.config, 1, 8)
+    logits_p, cache = forward_with_cache(model.params, model.spec.config,
+                                         toks[:, :3], 0, cache)
+    last = [logits_p[:, -1]]
+    for pos in range(3, 8):
+        step_logits, cache = forward_with_cache(
+            model.params, model.spec.config, toks[:, pos:pos + 1],
+            jnp.asarray(pos, jnp.int32), cache)
+        last.append(step_logits[:, -1])
+    for pos in range(3, 9):
+        want = model.apply(toks[:, :pos])[:, -1]
+        np.testing.assert_allclose(np.asarray(last[pos - 3]), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_generate_matches_naive_argmax_loop(model):
+    """generate(temperature=0) must equal the O(L^2) loop that re-runs the
+    module on the growing sequence and argmaxes the last position."""
+    prompt = jnp.asarray([[5, 17, 3], [40, 2, 60]], jnp.int32)
+    out = generate(model, prompt, max_new_tokens=6)
+    assert out.shape == (2, 6)
+
+    seq = prompt
+    for _ in range(6):
+        nxt = jnp.argmax(model.apply(seq)[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 3:]))
+
+
+def test_eos_rows_pad_after_stopping(model):
+    """Find an EOS id the greedy run actually emits, regenerate with it
+    declared: the EOS itself is kept, everything after is pad_id."""
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    free = np.asarray(generate(model, prompt, max_new_tokens=6))[0]
+    eos = int(free[2])  # declare the 3rd emitted token to be EOS
+    out = np.asarray(generate(model, prompt, max_new_tokens=6,
+                              eos_id=eos, pad_id=0))[0]
+    np.testing.assert_array_equal(out[:3], free[:3])
+    assert np.all(out[3:] == 0)
+
+
+def test_sampled_generation_reproducible_and_in_range(model):
+    fn = make_generate_fn(model.spec, 5, temperature=0.8, top_k=10)
+    rng = jax.random.PRNGKey(7)
+    a = fn(model.params, jnp.zeros((3, 4), jnp.int32), rng)
+    b = fn(model.params, jnp.zeros((3, 4), jnp.int32), rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (3, 5)
+    assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < 61))
+
+
+def test_generate_rejects_overflow_and_sharded_specs(model):
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, jnp.zeros((1, 30), jnp.int32), max_new_tokens=10)
+    sharded = _spec(seq_axis="sp")
+    with pytest.raises(ValueError, match="non-sharded"):
+        make_generate_fn(sharded, 4)
+    moe = _spec(moe_experts=4)
+    with pytest.raises(ValueError, match="MoE"):
+        make_generate_fn(moe, 4)
+
+
+def test_generate_rejects_undersized_cache(model):
+    fn = make_generate_fn(model.spec, 8, cache_len=4)
+    with pytest.raises(ValueError, match="cannot hold"):
+        fn(model.params, jnp.zeros((1, 3), jnp.int32))
