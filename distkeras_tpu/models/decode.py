@@ -112,9 +112,13 @@ def init_cache(config: dict, batch: int, cache_len: int) -> KVCache:
 
 
 def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
-                       start_pos, cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+                       start_pos, cache: KVCache,
+                       last_only: bool = False) -> Tuple[jnp.ndarray, KVCache]:
     """Run tokens [B, L] at positions ``start_pos..start_pos+L-1`` against
-    the cache; returns (float32 logits [B, L, vocab], updated cache).
+    the cache; returns (float32 logits, updated cache) — [B, L, vocab], or
+    [B, 1, vocab] when ``last_only`` (generation consumes only the final
+    position, and the [L, vocab] unembed matmul is the prefill's single
+    biggest op at real vocab sizes).
 
     Serves both phases: prefill (L = prompt length, start_pos = 0) and
     decode (L = 1, start_pos = current length).
@@ -130,6 +134,8 @@ def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
         x, k_all, v_all = _block(params[f"block_{i}"], x, k_all, v_all, i,
                                  start_pos, dtype)
 
+    if last_only:
+        x = x[:, -1:]
     x = _layer_norm(params["final_norm"], x, dtype)
     logits = jnp.einsum("ble,ve->blv", x.astype(jnp.float32),
                         params["embed"]["embedding"].astype(jnp.float32))
@@ -176,12 +182,13 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                 f"cache_len = {total} cannot hold prompt ({prompt_len}) + "
                 f"max_new_tokens ({max_new_tokens}); out-of-range cache "
                 "writes would silently clamp and corrupt generation")
-        if total > max_seq:
+        if prompt_len + max_new_tokens > max_seq:
             raise ValueError(
-                f"prompt + max_new_tokens = {total} exceeds the positional "
-                f"table max_seq_len = {max_seq}")
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the positional table max_seq_len = {max_seq}")
         cache = init_cache(config, prompt.shape[0], total)
-        logits, cache = forward_with_cache(params, config, prompt, 0, cache)
+        logits, cache = forward_with_cache(params, config, prompt, 0, cache,
+                                           last_only=True)
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature, top_k)
         # the EOS token itself is kept in the output; rows are padded after

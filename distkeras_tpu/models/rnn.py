@@ -1,0 +1,119 @@
+"""Recurrent models (LSTM / GRU) — the reference's Keras-RNN family.
+
+dist-keras trained whatever Keras models users handed it, and the Keras-1
+era zoo was heavy on LSTMs (SURVEY.md §2.1: the trainer holds an arbitrary
+serialized Keras model); this module gives the registry the recurrent
+members so that surface carries over.
+
+TPU notes: recurrence is the anti-MXU shape — a serial chain of small
+matmuls — so the implementation leans on what XLA *can* do well:
+``flax.linen.RNN`` lowers the time loop to one ``lax.scan`` (single
+compiled program, no per-step dispatch), the input/recurrent projections
+inside ``OptimizedLSTMCell`` are fused gate matmuls ([F, 4H] rather than
+four [F, H]s), and the whole batch rides each step so the MXU sees
+[B, F] x [F, 4H] tiles.  Long-context work belongs to the transformer
+family (ring/flash attention); this exists for model-zoo parity, not
+sequence scale.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.base import register_model
+
+
+def _carry_like(cell: nn.RNNCellBase, x: jnp.ndarray):
+    """Initial carry whose varying-manual-axes match ``x``.
+
+    Under ``shard_map`` (the distributed trainers) the inputs vary over the
+    replica axis but the cell's default zero carry does not, and the time
+    ``lax.scan`` rejects the carry-type mismatch; ``pcast`` the zeros to
+    x's vma.  Outside shard_map vma is empty and this is the identity.
+    """
+    carry = cell.initialize_carry(jax.random.PRNGKey(0), x[:, 0].shape)
+    vma = tuple(jax.typeof(x).vma)
+    if not vma:
+        return carry
+    return jax.tree.map(lambda c: lax.pcast(c, vma, to="varying"), carry)
+
+
+@register_model("rnn")
+class RNNClassifier(nn.Module):
+    """Token or feature sequences -> class logits.
+
+    Input is [B, T] int32 tokens when ``vocab_size > 0`` (embedded to
+    ``embed_dim``), else [B, T, F] float features.  Stacked recurrent
+    layers (``cell_type`` "lstm" or "gru"); the last layer's final hidden
+    state feeds the dense head (Keras ``LSTM(return_sequences=False)``
+    convention).
+    """
+
+    vocab_size: int = 0
+    embed_dim: int = 128
+    hidden_sizes: Sequence[int] = (128,)
+    cell_type: str = "lstm"
+    num_outputs: int = 2
+    compute_dtype: jnp.dtype = jnp.float32  # recurrent cells are small; the
+                                            # scan's serial latency, not
+                                            # matmul rate, bounds throughput
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.cell_type not in ("lstm", "gru"):
+            raise ValueError(f"cell_type must be 'lstm' or 'gru', got {self.cell_type!r}")
+        if self.vocab_size:
+            x = nn.Embed(self.vocab_size, self.embed_dim,
+                         dtype=self.compute_dtype)(x)
+        else:
+            x = x.astype(self.compute_dtype)
+        for i, h in enumerate(self.hidden_sizes):
+            cell = (nn.OptimizedLSTMCell(h, dtype=self.compute_dtype)
+                    if self.cell_type == "lstm"
+                    else nn.GRUCell(h, dtype=self.compute_dtype))
+            last = i == len(self.hidden_sizes) - 1
+            # return_carry gives the final state without materializing the
+            # [B, T, H] output sequence read we'd immediately discard
+            if last:
+                carry, _ = nn.RNN(cell, return_carry=True, name=f"rnn_{i}")(
+                    x, initial_carry=_carry_like(cell, x))
+                x = carry[1] if self.cell_type == "lstm" else carry
+            else:
+                x = nn.RNN(cell, name=f"rnn_{i}")(
+                    x, initial_carry=_carry_like(cell, x))
+        return nn.Dense(self.num_outputs, dtype=jnp.float32)(x)
+
+
+def lstm_classifier_spec(vocab_size: int = 1024, seq_len: int = 64,
+                         embed_dim: int = 128, hidden_sizes: Sequence[int] = (128,),
+                         num_outputs: int = 2, cell_type: str = "lstm"):
+    """Token-sequence classifier (IMDB-style sentiment shapes)."""
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="rnn",
+        config={"vocab_size": vocab_size, "embed_dim": embed_dim,
+                "hidden_sizes": tuple(hidden_sizes), "cell_type": cell_type,
+                "num_outputs": num_outputs},
+        input_shape=(seq_len,),
+        input_dtype="int32",
+    )
+
+
+def feature_rnn_spec(seq_len: int = 32, feature_dim: int = 8,
+                     hidden_sizes: Sequence[int] = (64,), num_outputs: int = 2,
+                     cell_type: str = "gru"):
+    """Float-feature sequence classifier (sensor/time-series shapes)."""
+    from distkeras_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        name="rnn",
+        config={"vocab_size": 0, "hidden_sizes": tuple(hidden_sizes),
+                "cell_type": cell_type, "num_outputs": num_outputs},
+        input_shape=(seq_len, feature_dim),
+    )

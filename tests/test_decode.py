@@ -109,6 +109,15 @@ def test_generate_rejects_overflow_and_sharded_specs(model):
         make_generate_fn(moe, 4)
 
 
+def test_oversized_cache_with_short_sequence_is_fine(model):
+    """An explicit cache larger than needed (even than max_seq_len's worth
+    of live rows) must not be rejected — dead rows are masked."""
+    fn = make_generate_fn(model.spec, 4, cache_len=32)
+    out = fn(model.params, jnp.asarray([[5, 17, 3]], jnp.int32))
+    want = generate(model, jnp.asarray([[5, 17, 3]], jnp.int32), max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
 def test_generate_rejects_undersized_cache(model):
     fn = make_generate_fn(model.spec, 8, cache_len=4)
     with pytest.raises(ValueError, match="cannot hold"):
