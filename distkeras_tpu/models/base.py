@@ -56,10 +56,13 @@ class ModelSpec:
     input_dtype: str = "float32"
 
     def __post_init__(self):
-        # canonicalize so a JSON round-trip (tuples -> lists) compares equal
+        # canonicalize so a JSON round-trip (tuples -> lists) compares equal;
+        # recurses through dicts too (sequential's layer dicts nest configs)
         def canon(v):
             if isinstance(v, (list, tuple)):
                 return tuple(canon(x) for x in v)
+            if isinstance(v, dict):
+                return {k: canon(x) for k, x in v.items()}
             return v
 
         object.__setattr__(self, "config", {k: canon(v) for k, v in self.config.items()})

@@ -1,0 +1,207 @@
+"""``Sequential`` — arbitrary layer-stack models, the Keras ``Sequential``
+equivalent.
+
+The reference trains *any* Keras model the user hands it (SURVEY §2.1: the
+trainer holds a serialized model; §2.19: ``serialize_keras_model`` ships
+``{json architecture, weights}``).  The registry's named families (mlp,
+cnn, resnet, ...) cover the example zoo but not that open-endedness; this
+module restores it: an architecture is a JSON-safe list of layer dicts, so
+user-defined stacks serialize/deserialize through the same
+``Model.serialize`` path as every built-in, with no Python code shipped.
+
+Layer kinds (constructor sugar below builds the dicts):
+
+- ``dense(units, activation=None)``
+- ``conv2d(filters, kernel_size, strides=1, padding="SAME", activation=None)``
+  — NHWC, the TPU-preferred conv layout
+- ``max_pool2d(window, strides=None)`` / ``avg_pool2d(window, strides=None)``
+- ``global_avg_pool()`` — mean over spatial dims
+- ``flatten()``
+- ``activation(name)`` — relu | gelu | tanh | sigmoid | softmax | elu |
+  leaky_relu
+- ``layer_norm()``
+- ``dropout(rate)`` — **inert in v1**: the framework's compiled training
+  step is deterministic (no rng plumbed through ``apply_fn``); the layer
+  is accepted for architecture parity and applies identity.  A loud
+  ``UserWarning`` at build time says so.
+- ``embed(vocab_size, dim)`` — int tokens [B, T] -> [B, T, dim]
+
+BatchNorm is deliberately absent: it needs mutable ``batch_stats``
+threaded through every trainer; use ``layer_norm`` (the TPU-era norm) —
+an explicit error points there.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import register_model
+
+_ACTIVATIONS = {
+    "relu": nn.relu, "gelu": nn.gelu, "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid, "softmax": nn.softmax, "elu": nn.elu,
+    "leaky_relu": nn.leaky_relu,
+}
+
+# allowed keys per layer kind — hand-written dicts are the advertised
+# interface, so a typo'd key ('stride', 'pad') must fail loudly instead of
+# silently falling back to a default
+_ALLOWED_KEYS = {
+    "dense": {"units", "activation"},
+    "conv2d": {"filters", "kernel_size", "strides", "padding", "activation"},
+    "max_pool2d": {"window", "strides"},
+    "avg_pool2d": {"window", "strides"},
+    "global_avg_pool": set(),
+    "flatten": set(),
+    "activation": {"name"},
+    "layer_norm": set(),
+    "dropout": {"rate"},
+    "embed": {"vocab_size", "dim"},
+    "batch_norm": set(),
+}
+
+
+def _activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; one of {sorted(_ACTIVATIONS)}") from None
+
+
+def _pair(v) -> Tuple[int, int]:
+    return (int(v), int(v)) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+@register_model("sequential")
+class Sequential(nn.Module):
+    """Applies ``layers`` (a tuple of layer-config dicts) in order."""
+
+    layers: Tuple[Dict[str, Any], ...] = ()
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if not self.layers:
+            raise ValueError("sequential model needs at least one layer")
+        for i, layer in enumerate(self.layers):
+            kind = layer.get("kind")
+            if kind in _ALLOWED_KEYS:
+                extra = set(layer) - _ALLOWED_KEYS[kind] - {"kind"}
+                if extra:
+                    raise ValueError(
+                        f"layer {i}: unknown key(s) {sorted(extra)} for kind "
+                        f"{kind!r}; allowed: {sorted(_ALLOWED_KEYS[kind])}")
+            if kind == "dropout":
+                warnings.warn(
+                    "dropout layers are inert in v1 (the compiled training "
+                    "step is deterministic); remove them or expect identity "
+                    "behavior", UserWarning, stacklevel=2)
+            if kind == "dense":
+                x = nn.Dense(int(layer["units"]), dtype=self.compute_dtype,
+                             name=f"dense_{i}")(x)
+            elif kind == "conv2d":
+                x = nn.Conv(int(layer["filters"]), _pair(layer["kernel_size"]),
+                            strides=_pair(layer.get("strides", 1)),
+                            padding=layer.get("padding", "SAME"),
+                            dtype=self.compute_dtype, name=f"conv_{i}")(x)
+            elif kind == "max_pool2d":
+                w = _pair(layer["window"])
+                x = nn.max_pool(x, w, strides=_pair(layer.get("strides") or layer["window"]))
+            elif kind == "avg_pool2d":
+                w = _pair(layer["window"])
+                x = nn.avg_pool(x, w, strides=_pair(layer.get("strides") or layer["window"]))
+            elif kind == "global_avg_pool":
+                x = x.mean(axis=tuple(range(1, x.ndim - 1)))
+            elif kind == "flatten":
+                x = x.reshape((x.shape[0], -1))
+            elif kind == "activation":
+                x = _activation(layer["name"])(x)
+            elif kind == "layer_norm":
+                x = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_{i}")(x)
+            elif kind == "dropout":
+                pass  # inert (see module docstring); warned above
+            elif kind == "embed":
+                x = nn.Embed(int(layer["vocab_size"]), int(layer["dim"]),
+                             dtype=self.compute_dtype, name=f"embed_{i}")(x)
+            elif kind == "batch_norm":
+                raise ValueError(
+                    "batch_norm is not supported (mutable batch_stats don't "
+                    "thread through the compiled trainers); use layer_norm")
+            else:
+                raise ValueError(f"layer {i}: unknown kind {kind!r}")
+            act = layer.get("activation")
+            if act and kind in ("dense", "conv2d"):
+                x = _activation(act)(x)
+        return x
+
+
+# -- layer-dict constructors (the user-facing sugar) --------------------------
+
+def dense(units: int, activation: Optional[str] = None) -> dict:
+    return {"kind": "dense", "units": units, "activation": activation}
+
+
+def conv2d(filters: int, kernel_size: Union[int, Sequence[int]],
+           strides: Union[int, Sequence[int]] = 1, padding: str = "SAME",
+           activation: Optional[str] = None) -> dict:
+    return {"kind": "conv2d", "filters": filters, "kernel_size": kernel_size,
+            "strides": strides, "padding": padding, "activation": activation}
+
+
+def max_pool2d(window: Union[int, Sequence[int]],
+               strides: Union[int, Sequence[int], None] = None) -> dict:
+    return {"kind": "max_pool2d", "window": window, "strides": strides}
+
+
+def avg_pool2d(window: Union[int, Sequence[int]],
+               strides: Union[int, Sequence[int], None] = None) -> dict:
+    return {"kind": "avg_pool2d", "window": window, "strides": strides}
+
+
+def global_avg_pool() -> dict:
+    return {"kind": "global_avg_pool"}
+
+
+def flatten() -> dict:
+    return {"kind": "flatten"}
+
+
+def activation(name: str) -> dict:
+    return {"kind": "activation", "name": name}
+
+
+def layer_norm() -> dict:
+    return {"kind": "layer_norm"}
+
+
+def dropout(rate: float) -> dict:
+    return {"kind": "dropout", "rate": rate}
+
+
+def embed(vocab_size: int, dim: int) -> dict:
+    return {"kind": "embed", "vocab_size": vocab_size, "dim": dim}
+
+
+def sequential_spec(layers: Sequence[dict], input_shape: Sequence[int],
+                    input_dtype: str = "float32"):
+    """ModelSpec for a layer stack: the Keras-``Sequential`` entry point.
+
+    >>> spec = sequential_spec(
+    ...     [conv2d(32, 3, activation="relu"), max_pool2d(2),
+    ...      flatten(), dense(128, "relu"), dense(10)],
+    ...     input_shape=(28, 28, 1))
+    """
+    from distkeras_tpu.models.base import ModelSpec
+
+    layers = [dict(l) for l in layers]
+    return ModelSpec(
+        name="sequential",
+        config={"layers": layers},
+        input_shape=tuple(input_shape),
+        input_dtype=input_dtype,
+    )

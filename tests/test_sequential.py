@@ -1,0 +1,104 @@
+"""Sequential (arbitrary layer-stack) models: the Keras-Sequential parity
+surface — construction, serialization, training, and error reporting."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.models.sequential import (activation, avg_pool2d, conv2d,
+                                             dense, dropout, embed, flatten,
+                                             global_avg_pool, layer_norm,
+                                             max_pool2d, sequential_spec)
+from distkeras_tpu.trainers import DOWNPOUR, SingleTrainer
+
+
+def test_cnn_stack_shapes_match_hand_built():
+    spec = sequential_spec(
+        [conv2d(8, 3, activation="relu"), max_pool2d(2),
+         conv2d(16, 3, activation="relu"), avg_pool2d(2),
+         flatten(), dense(32, "relu"), layer_norm(), dense(10)],
+        input_shape=(28, 28, 1))
+    m = Model.init(spec, seed=0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 28, 28, 1)), jnp.float32)
+    assert m.apply(x).shape == (4, 10)
+
+
+def test_serialize_roundtrip_rebuilds_identical_model():
+    spec = sequential_spec(
+        [embed(vocab_size=30, dim=8), global_avg_pool(), dense(4)],
+        input_shape=(12,), input_dtype="int32")
+    m = Model.init(spec, seed=3)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 30, (5, 12)))
+    m2 = Model.deserialize(m.serialize())
+    np.testing.assert_array_equal(np.asarray(m2.apply(toks)), np.asarray(m.apply(toks)))
+
+
+def test_sequential_trains_with_single_and_distributed_trainers():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8, 8, 1)).astype(np.float32)
+    labels = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int64)
+    onehot = np.eye(2, dtype=np.float32)[labels]
+    ds = Dataset({"features": x, "label": onehot})
+    spec = sequential_spec(
+        [conv2d(4, 3, activation="relu"), flatten(), dense(16, "relu"), dense(2)],
+        input_shape=(8, 8, 1))
+
+    tr = SingleTrainer(spec, batch_size=32, num_epoch=10, learning_rate=0.05)
+    model = tr.train(ds)
+    pred = np.argmax(np.asarray(model.apply(jnp.asarray(x))), axis=1)
+    assert (pred == labels).mean() > 0.9
+
+    tr2 = DOWNPOUR(spec, num_workers=8, batch_size=16, num_epoch=2,
+                   communication_window=2, learning_rate=0.05)
+    model2 = tr2.train(ds)
+    assert model2.apply(jnp.asarray(x[:4])).shape == (4, 2)
+    assert np.isfinite(tr2.history).all()
+
+
+def test_activation_and_kind_errors_name_the_layer():
+    bad = sequential_spec([dense(4), {"kind": "wat"}], input_shape=(3,))
+    with pytest.raises(ValueError, match="layer 1: unknown kind 'wat'"):
+        Model.init(bad, seed=0)
+    with pytest.raises(ValueError, match="unknown activation"):
+        Model.init(sequential_spec([dense(4, "swishh")], input_shape=(3,)), seed=0)
+    with pytest.raises(ValueError, match="layer_norm"):
+        Model.init(sequential_spec([{"kind": "batch_norm"}], input_shape=(3,)), seed=0)
+    with pytest.raises(ValueError, match="at least one layer"):
+        Model.init(sequential_spec([], input_shape=(3,)), seed=0)
+
+
+def test_dropout_warns_and_is_inert():
+    spec = sequential_spec([dense(4, "relu"), dropout(0.5), dense(2)],
+                           input_shape=(3,))
+    with pytest.warns(UserWarning, match="inert"):
+        m = Model.init(spec, seed=0)
+    x = jnp.ones((2, 3))
+    np.testing.assert_array_equal(np.asarray(m.apply(x)), np.asarray(m.apply(x)))
+
+
+def test_typoed_layer_keys_fail_loudly():
+    bad = sequential_spec(
+        [{"kind": "conv2d", "filters": 8, "kernel_size": 3, "stride": 2}],
+        input_shape=(8, 8, 1))
+    with pytest.raises(ValueError, match=r"layer 0: unknown key\(s\) \['stride'\]"):
+        Model.init(bad, seed=0)
+
+
+def test_tuple_layer_params_survive_serialize_roundtrip():
+    spec = sequential_spec([conv2d(8, (3, 3)), flatten(), dense(4)],
+                           input_shape=(8, 8, 1))
+    m = Model.init(spec, seed=0)
+    m2 = Model.deserialize(m.serialize())
+    assert m2.spec == m.spec
+
+
+def test_activation_layer_and_pool_defaults():
+    spec = sequential_spec(
+        [conv2d(4, [3, 3], strides=[1, 1], padding="VALID"),
+         activation("tanh"), max_pool2d([2, 2]), flatten(), dense(3)],
+        input_shape=(10, 10, 2))
+    m = Model.init(spec, seed=0)
+    out = m.apply(jnp.zeros((2, 10, 10, 2)))
+    assert out.shape == (2, 3)
