@@ -220,6 +220,55 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     return generate_fn
 
 
+def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
+                             tp_axis: Optional[str] = "tp",
+                             dp_axis: Optional[str] = None, **kw):
+    """Distributed decoding via GSPMD sharding propagation.
+
+    Rather than rewriting the cache math in shard_map, this places the
+    params with the SAME Megatron partition specs the tensor-parallel
+    training step uses (``parallel/lm.py :: lm_param_specs``: qkv
+    column-parallel over heads, proj/down row-parallel, up column-parallel)
+    and the prompt batch over ``dp_axis``, then lets XLA's sharding
+    propagation partition the jitted generation program — the KV cache
+    inherits the head sharding from the qkv einsum, attention stays local
+    to the head shard, and the row-parallel matmuls become psums over ICI.
+    Compiler-first: the single-device program IS the distributed program.
+
+    Returns ``fn(params, prompt, rng=None) -> tokens [B, max_new_tokens]``;
+    placement happens inside, so callers pass ordinary host/device arrays.
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from distkeras_tpu.parallel.lm import lm_param_specs
+
+    inner = make_generate_fn(spec, max_new_tokens, **kw)  # validates the spec
+    for name, axis in (("tp_axis", tp_axis), ("dp_axis", dp_axis)):
+        # a typo'd axis must not silently degrade to full replication
+        if axis is not None and axis not in mesh.shape:
+            raise ValueError(f"{name} {axis!r} is not a mesh axis of {mesh}; "
+                             "pass None to disable that parallelism")
+    tp = mesh.shape[tp_axis] if tp_axis else 1
+    if spec.config["num_heads"] % tp:
+        raise ValueError(f"num_heads {spec.config['num_heads']} not divisible "
+                         f"by tp={tp} over mesh axis {tp_axis!r}")
+
+    def fn(params, prompt, rng=None):
+        if dp_axis and prompt.shape[0] % mesh.shape[dp_axis]:
+            raise ValueError(f"batch {prompt.shape[0]} not divisible by "
+                             f"dp={mesh.shape[dp_axis]}")
+        pspecs = lm_param_specs(params, tp_axis if tp > 1 else None)
+        params = jax.device_put(params, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), pspecs,
+            is_leaf=lambda x: isinstance(x, P)))
+        prompt = jax.device_put(jnp.asarray(prompt), NamedSharding(
+            mesh, P(dp_axis) if dp_axis else P()))
+        return inner(params, prompt, rng)
+
+    return fn
+
+
 def generate(model: Model, prompt: jnp.ndarray, max_new_tokens: int,
              *, temperature: float = 0.0, top_k: int = 0,
              eos_id: Optional[int] = None, pad_id: int = 0,

@@ -122,3 +122,40 @@ def test_generate_rejects_undersized_cache(model):
     fn = make_generate_fn(model.spec, 8, cache_len=4)
     with pytest.raises(ValueError, match="cannot hold"):
         fn(model.params, jnp.zeros((1, 3), jnp.int32))
+
+
+def test_sharded_generate_matches_single_device(model):
+    """GSPMD-partitioned decoding ((dp x tp) mesh) must reproduce the
+    single-device greedy tokens — the collectives change the schedule,
+    not the math (float32 compute keeps argmax ties deterministic)."""
+    from distkeras_tpu.models.decode import make_sharded_generate_fn
+    from distkeras_tpu.parallel.mesh import create_nd_mesh
+
+    mesh = create_nd_mesh((2, 2), ("dp", "tp"))
+    prompt = jnp.asarray([[5, 17, 3], [40, 2, 60]], jnp.int32)
+    want = generate(model, prompt, max_new_tokens=6)
+    fn = make_sharded_generate_fn(model.spec, mesh, 6, tp_axis="tp", dp_axis="dp")
+    got = fn(model.params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sharded_generate_rejects_indivisible_heads(model):
+    from distkeras_tpu.models.decode import make_sharded_generate_fn
+    from distkeras_tpu.parallel.mesh import create_nd_mesh
+
+    mesh = create_nd_mesh((8,), ("tp",))  # model has 2 heads
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sharded_generate_fn(model.spec, mesh, 4)
+
+
+def test_sharded_generate_rejects_bad_axis_and_spec(model):
+    from distkeras_tpu.models.decode import make_sharded_generate_fn
+    from distkeras_tpu.models.sequential import dense, sequential_spec
+    from distkeras_tpu.parallel.mesh import create_nd_mesh
+
+    mesh = create_nd_mesh((2, 2), ("dp", "tp"))
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        make_sharded_generate_fn(model.spec, mesh, 4, tp_axis="model")
+    with pytest.raises(ValueError, match="transformer_lm"):
+        make_sharded_generate_fn(sequential_spec([dense(4)], input_shape=(3,)),
+                                 mesh, 4)
