@@ -62,6 +62,10 @@ _EXPORTS = {
     "ModelPredictor": "distkeras_tpu.predictors",
     "AccuracyEvaluator": "distkeras_tpu.evaluators",
     "pin_cpu_devices": "distkeras_tpu.platform",
+    "get_optimizer": "distkeras_tpu.ops.optimizers",
+    "get_schedule": "distkeras_tpu.ops.optimizers",
+    "get_loss": "distkeras_tpu.ops.losses",
+    "register_loss": "distkeras_tpu.ops.losses",
 }
 
 __all__ = list(_EXPORTS)

@@ -10,18 +10,25 @@ arithmetic, exactly like the reference PS.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Callable, Optional, Union
 
 import optax
 
+# learning_rate below may be a float OR an optax schedule (step -> lr);
+# optax optimizers accept both natively, so trainers get LR schedules for
+# free by passing get_schedule(...) as learning_rate
+ScalarOrSchedule = Union[float, Callable]
 
-def get_optimizer(spec: Union[str, optax.GradientTransformation], learning_rate: float = 0.01,
+
+def get_optimizer(spec: Union[str, optax.GradientTransformation],
+                  learning_rate: ScalarOrSchedule = 0.01,
                   momentum: Optional[float] = None) -> optax.GradientTransformation:
     """Resolve a Keras-style optimizer name into an optax transform.
 
     ``spec`` may already be an ``optax.GradientTransformation`` (returned
     unchanged), or one of: ``sgd``, ``momentum``, ``nesterov``, ``adam``,
-    ``adamw``, ``adagrad``, ``rmsprop``, ``adadelta``.
+    ``adamw``, ``adamax``, ``nadam``, ``adagrad``, ``rmsprop``,
+    ``adadelta``, ``lamb``, ``lars``, ``lion``.
     """
     if isinstance(spec, optax.GradientTransformation):
         return spec
@@ -35,14 +42,46 @@ def get_optimizer(spec: Union[str, optax.GradientTransformation], learning_rate:
         return optax.sgd(learning_rate, momentum=mom)
     if name == "nesterov":
         return optax.sgd(learning_rate, momentum=mom, nesterov=True)
-    if name == "adam":
-        return optax.adam(learning_rate)
-    if name == "adamw":
-        return optax.adamw(learning_rate)
-    if name == "adagrad":
-        return optax.adagrad(learning_rate)
-    if name == "rmsprop":
-        return optax.rmsprop(learning_rate)
-    if name == "adadelta":
-        return optax.adadelta(learning_rate)
-    raise ValueError(f"unknown optimizer {spec!r}")
+    simple = {"adam": optax.adam, "adamw": optax.adamw, "adamax": optax.adamax,
+              "nadam": optax.nadam, "adagrad": optax.adagrad,
+              "rmsprop": optax.rmsprop, "adadelta": optax.adadelta,
+              "lamb": optax.lamb, "lars": optax.lars, "lion": optax.lion}
+    if name in simple:
+        return simple[name](learning_rate)
+    raise ValueError(f"unknown optimizer {spec!r}; known: sgd, momentum, "
+                     f"nesterov, {', '.join(sorted(simple))}")
+
+
+def get_schedule(name: str, learning_rate: float, decay_steps: int, *,
+                 warmup_steps: int = 0, end_value: float = 0.0,
+                 decay_rate: float = 0.96) -> Callable:
+    """Build an optax learning-rate schedule by Keras-ish name.
+
+    ``cosine`` | ``linear`` | ``exponential`` | ``constant`` — each
+    optionally preceded by ``warmup_steps`` of linear warmup from 0.
+    Pass the result as any trainer's ``learning_rate=``; ``decay_steps``
+    counts optimizer updates (batches), not epochs.
+
+    Note: AEASGD/EAMSGD additionally need their scalar elastic coupling
+    (alpha = rho * lr); give those trainers a scalar ``learning_rate`` and
+    put the schedule inside an optax ``worker_optimizer`` object instead.
+    """
+    n = name.lower()
+    if n == "cosine":
+        sched = optax.cosine_decay_schedule(learning_rate, decay_steps,
+                                            alpha=end_value / learning_rate
+                                            if learning_rate else 0.0)
+    elif n == "linear":
+        sched = optax.linear_schedule(learning_rate, end_value, decay_steps)
+    elif n == "exponential":
+        sched = optax.exponential_decay(learning_rate, decay_steps, decay_rate,
+                                        end_value=end_value or None)
+    elif n == "constant":
+        sched = optax.constant_schedule(learning_rate)
+    else:
+        raise ValueError(f"unknown schedule {name!r}; known: cosine, linear, "
+                         "exponential, constant")
+    if warmup_steps:
+        warm = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+        sched = optax.join_schedules([warm, sched], [warmup_steps])
+    return sched

@@ -1,0 +1,70 @@
+"""Optimizer registry and learning-rate schedules."""
+
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.base import ModelSpec
+from distkeras_tpu.ops.optimizers import get_optimizer, get_schedule
+from distkeras_tpu.trainers import AEASGD, SingleTrainer
+
+
+def test_all_registry_names_build_and_step():
+    import jax.numpy as jnp
+
+    names = ["sgd", "momentum", "nesterov", "adam", "adamw", "adamax",
+             "nadam", "adagrad", "rmsprop", "adadelta", "lamb", "lars", "lion"]
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.full((4,), 0.5)}
+    for name in names:
+        opt = get_optimizer(name, learning_rate=0.1)
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        assert np.isfinite(np.asarray(updates["w"])).all(), name
+
+
+def test_unknown_name_and_passthrough():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        get_optimizer("sgdd")
+    obj = optax.sgd(0.1)
+    assert get_optimizer(obj) is obj
+
+
+def test_schedules_shapes():
+    s = get_schedule("cosine", 0.1, decay_steps=100, warmup_steps=10)
+    assert float(s(0)) == 0.0                      # warmup starts at 0
+    assert abs(float(s(10)) - 0.1) < 1e-6          # peak after warmup
+    assert float(s(110)) < 0.01                    # decayed
+    lin = get_schedule("linear", 0.2, decay_steps=10, end_value=0.02)
+    assert abs(float(lin(10)) - 0.02) < 1e-6
+    exp = get_schedule("exponential", 0.1, decay_steps=10, decay_rate=0.5)
+    assert abs(float(exp(10)) - 0.05) < 1e-6
+    floored = get_schedule("exponential", 0.1, decay_steps=10, decay_rate=0.5,
+                           end_value=0.05)
+    assert float(floored(100)) == pytest.approx(0.05)
+    const = get_schedule("constant", 0.3, decay_steps=1)
+    assert float(const(999)) == pytest.approx(0.3)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        get_schedule("staircase", 0.1, 10)
+
+
+def test_trainer_accepts_schedule_as_learning_rate():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(8,))
+    sched = get_schedule("cosine", 0.05, decay_steps=20, warmup_steps=2)
+    tr = SingleTrainer(spec, learning_rate=sched, batch_size=16, num_epoch=3)
+    model = tr.train(Dataset({"features": x, "label": y}))
+    assert np.isfinite(tr.history).all()
+    assert model.apply(x[:2]).shape == (2, 2)
+
+
+def test_elastic_trainers_reject_schedule_learning_rate():
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(8,))
+    sched = get_schedule("cosine", 0.05, decay_steps=20)
+    with pytest.raises(ValueError, match="scalar learning_rate"):
+        AEASGD(spec, learning_rate=sched, num_workers=2)
