@@ -55,32 +55,39 @@ _STAT_LANES = 8  # trailing lanes for per-row stats (min f32 tile lane count
 
 
 class _Config(NamedTuple):
-    """Static kernel configuration (hashable: custom_vjp nondiff argument)."""
+    """Static kernel configuration (hashable: custom_vjp nondiff argument).
+
+    Forward and backward may use different block sizes: the dkv kernel
+    carries ~2x the VMEM working set of the forward (two f32 scratch
+    accumulators + dO tiles), so the forward can afford (1024, 1024) where
+    the backward must stay at (512, 1024) to fit scoped vmem inside full
+    transformer programs."""
 
     causal: bool
     q_offset: int
     k_offset: int
     block_q: int
     block_k: int
+    block_q_bwd: int
+    block_k_bwd: int
     interpret: bool
 
 
-def _block_visible(cfg: _Config, qi, kj):
+def _block_visible(cfg: _Config, qi, kj, bq, bk):
     """True unless key block ``kj`` is entirely in query block ``qi``'s
-    masked future (then its FLOPs are predicated away)."""
+    masked future (then its FLOPs are predicated away).  Block sizes are
+    explicit because forward and backward kernels may use different ones."""
     if not cfg.causal:
         return True
-    last_q_pos = cfg.q_offset + (qi + 1) * cfg.block_q - 1
-    first_k_pos = cfg.k_offset + kj * cfg.block_k
+    last_q_pos = cfg.q_offset + (qi + 1) * bq - 1
+    first_k_pos = cfg.k_offset + kj * bk
     return last_q_pos >= first_k_pos
 
 
-def _apply_causal_mask(s, cfg: _Config, qi, kj):
+def _apply_causal_mask(s, cfg: _Config, qi, kj, bq, bk):
     """Mask ``s`` [bq, bk] where q_pos < k_pos — but only blocks that
     straddle the diagonal pay for the iota+where; blocks fully below it
     (first q row sees the last k column) pass through untouched."""
-    bq, bk = cfg.block_q, cfg.block_k
-
     def masked(s):
         q_pos = cfg.q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = cfg.k_offset + kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -103,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_visible(cfg, qi, kj))
+    @pl.when(_block_visible(cfg, qi, kj, bq, bk))
     def _compute():
         q = q_ref[0, 0]  # [bq, d] — native dtype: bf16 x bf16 at full MXU rate
         k_blk = k_ref[0, 0]
@@ -111,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if cfg.causal:
-            s = _apply_causal_mask(s, cfg, qi, kj)
+            s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
         m = m_scr[:, 0]
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
@@ -143,13 +150,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, cfg: _Config, scale: float):
     qi, kj = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
-    bq, bk = cfg.block_q, cfg.block_k
+    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
 
     @pl.when(kj == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_visible(cfg, qi, kj))
+    @pl.when(_block_visible(cfg, qi, kj, bq, bk))
     def _compute():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
@@ -160,7 +167,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if cfg.causal:
-            s = _apply_causal_mask(s, cfg, qi, kj)
+            s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
         p = jnp.exp(s - lse)  # masked/-inf entries -> exactly 0
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -178,14 +185,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, cfg: _Config, scale: float):
     kj, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
-    bq, bk = cfg.block_q, cfg.block_k
+    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_visible(cfg, qi, kj))
+    @pl.when(_block_visible(cfg, qi, kj, bq, bk))
     def _compute():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
@@ -196,7 +203,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if cfg.causal:
-            s = _apply_causal_mask(s, cfg, qi, kj)
+            s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
         p = jnp.exp(s - lse)
         dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -247,7 +254,7 @@ def _forward(q, k, v, cfg: _Config):
 def _backward(q, k, v, o, lse, do, cfg: _Config):
     b, h, lq, d = q.shape
     lk = k.shape[2]
-    bq, bk = cfg.block_q, cfg.block_k
+    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
     scale = 1.0 / (d ** 0.5)
     # delta[b, h, i] = sum_d dO * O — the softmax-jacobian row term; tiny
     # elementwise reduce, XLA fuses it, no kernel needed
@@ -327,18 +334,27 @@ def _pick_block(block: int, length: int) -> int:
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, q_offset: int = 0, k_offset: int = 0,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: Optional[int] = None, block_k: int = 1024,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, L, H, D] tensors (same layout/semantics as
     ``ops.attention.dense_attention``, including the shard offsets).
 
-    Default blocks (512, 1024) are from a v5e sweep (128..1024, fwd+bwd,
-    2026-07-30): 1.19x the XLA dense path at 2k tokens / 1.61x at 8k,
-    within ~7% of the (1024, 1024) peak (1.23x / 1.72x) while leaving
-    VMEM headroom — (1024, 1024) sits at 16.01M/16.00M scoped-vmem inside
-    full transformer backward programs and fails to compile there.  Small
-    blocks lose badly (128 runs at 0.4x dense).  ``_pick_block`` shrinks
-    blocks to fit short sequences automatically.
+    Forward and backward kernels take independent block sizes.  Defaults
+    (v5e sweeps, 2026-07-30): the forward auto-selects ``block_q`` 1024 at
+    >= 16k tokens (~14% faster at 32k) and 512 below; the auto backward
+    stays at (512, ``block_k``) because the dkv kernel's working set at
+    (1024, 1024) lands 8K over the 16M scoped-vmem limit inside full
+    transformer backward programs.  (512, 1024) is within ~7% of peak at
+    2k/8k; small blocks lose badly (128 runs at 0.4x dense).
+
+    Explicit ``block_q``/``block_k`` are inherited by the backward unless
+    ``block_q_bwd``/``block_k_bwd`` override them — so callers tuning
+    blocks (to fix a scoped-vmem overflow, or to use a full-length block
+    on a non-8-divisible sequence) control both passes with one knob.
+    ``_pick_block`` shrinks every block to fit short sequences
+    automatically.
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     identical kernel code runs (slowly) in CPU tests.
@@ -346,19 +362,30 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
+    if block_q_bwd is None:
+        # inherit an explicit forward block; the 16k auto-upgrade must NOT
+        # propagate (1024-block bwd is the scoped-vmem overflow)
+        block_q_bwd = 512 if block_q is None else block_q
+    if block_k_bwd is None:
+        block_k_bwd = block_k
+    if block_q is None:
+        block_q = 1024 if lq >= 16384 else 512
     bq, bk = _pick_block(block_q, lq), _pick_block(block_k, lk)
-    for name, blk, length in (("block_q", bq, lq), ("block_k", bk, lk)):
+    bq_b, bk_b = _pick_block(block_q_bwd, lq), _pick_block(block_k_bwd, lk)
+    for name, blk, length in (("block_q", bq, lq), ("block_k", bk, lk),
+                              ("block_q_bwd", bq_b, lq), ("block_k_bwd", bk_b, lk)):
         # Mosaic tiling: the sublane block dim must be 8-divisible or span
         # the whole array dim (interpret mode is lenient, but keep semantics
         # identical so CPU tests catch what TPU would reject)
         if blk % 8 != 0 and blk != length:
             raise ValueError(
-                f"no Mosaic-legal {name} for sequence length {length}: largest "
-                f"divisor <= {block_q if name == 'block_q' else block_k} is {blk}, "
-                f"which is neither 8-divisible nor the full length; pad the "
-                f"sequence or use impl='dense'")
+                f"no Mosaic-legal {name} for sequence length {length}: "
+                f"largest fitting divisor is {blk}, which is neither "
+                f"8-divisible nor the full length; pad the sequence or use "
+                f"impl='dense'")
     cfg = _Config(causal=bool(causal), q_offset=int(q_offset), k_offset=int(k_offset),
-                  block_q=bq, block_k=bk, interpret=bool(interpret))
+                  block_q=bq, block_k=bk, block_q_bwd=bq_b, block_k_bwd=bk_b,
+                  interpret=bool(interpret))
     # [B, L, H, D] -> [B, H, L, D] for the kernels; the transposes sit outside
     # the custom_vjp so their adjoints are handled by XLA
     o = _flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2), cfg)

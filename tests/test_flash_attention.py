@@ -144,3 +144,40 @@ def test_odd_block_sizes_fall_back_to_divisors():
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
     ref = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bwd_blocks_inherit_explicit_fwd_blocks():
+    """Explicit block_q/block_k govern the backward too (multi-block bwd
+    scratch accumulation is exercised), and a full-length block on a
+    non-8-divisible sequence stays legal for both passes."""
+    import jax
+
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 64, 2, 16)) * 0.1, jnp.float32)
+               for _ in range(3))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v))
+
+    # blocks of 16 over L=64 -> 4x4 bwd grids: cross-block accumulation
+    small = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=16, block_k=16, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(loss(lambda q, k, v: dense_attention(q, k, v, causal=True)),
+                   argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(small, ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+
+    # L=33: only the full-length block is Mosaic-legal; fwd AND bwd must
+    # both inherit it rather than erroring on the bwd default of 512->1
+    q2, k2, v2 = (jnp.asarray(rng.normal(size=(1, 33, 2, 16)) * 0.1, jnp.float32)
+                  for _ in range(3))
+    g = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=33, block_k=33, interpret=True)),
+        argnums=(0, 1, 2))(q2, k2, v2)
+    r = jax.grad(loss(lambda q, k, v: dense_attention(q, k, v, causal=True)),
+                 argnums=(0, 1, 2))(q2, k2, v2)
+    for got, want in zip(g, r):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
