@@ -62,6 +62,8 @@ _EXPORTS = {
     "ModelPredictor": "distkeras_tpu.predictors",
     "AccuracyEvaluator": "distkeras_tpu.evaluators",
     "pin_cpu_devices": "distkeras_tpu.platform",
+    "quantize_params": "distkeras_tpu.ops.quantize",
+    "dequantize_params": "distkeras_tpu.ops.quantize",
     "get_optimizer": "distkeras_tpu.ops.optimizers",
     "get_schedule": "distkeras_tpu.ops.optimizers",
     "get_loss": "distkeras_tpu.ops.losses",

@@ -1,0 +1,118 @@
+"""Weight-only int8 quantization for inference.
+
+No reference counterpart (the reference serves full-precision Keras models;
+SURVEY §2.15) — this is TPU-native headroom for the serving path: matmul
+weights are stored in HBM as int8 with a float32 scale per output channel
+and dequantized to the compute dtype inside the compiled program, where XLA
+fuses the ``q.astype(dtype) * scale`` into the consumer.  Inference at
+batch sizes below the MXU's arithmetic-intensity knee is HBM-bound on
+weight reads, so halving (vs bf16) or quartering (vs f32) the weight bytes
+moves the bound directly.
+
+Scheme: symmetric per-channel. For a kernel ``w`` of any rank, the LAST
+axis is the output-channel axis (flax convention: Dense [in, out], Conv
+[kh, kw, cin, cout], DenseGeneral qkv [e, 3, h, dh] — reduced over all
+axes but the last):
+
+    scale[c] = max(|w[..., c]|) / 127
+    q[..., c] = round(w[..., c] / scale[c])  in [-127, 127]
+
+Leaves are quantized only when they are matmul-shaped (ndim >= 2, named
+``kernel`` or ``embedding``) and large enough to matter
+(``min_size`` elements); biases, norms scales, and tiny tensors stay in
+their original dtype — they are a rounding error of the HBM traffic and
+quantizing them costs accuracy for nothing.
+
+Usage:
+    qp = quantize_params(model.params)            # pytree with QTensor leaves
+    params = dequantize_params(qp)                # inside jit: fused dequant
+    ModelPredictor(model, quantize=True)          # transparent serving path
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QTensor(NamedTuple):
+    """int8 values + per-output-channel float32 scale (broadcastable)."""
+
+    q: jnp.ndarray       # int8, same shape as the original weight
+    scale: jnp.ndarray   # float32, shape (1, ..., 1, channels)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def _is_qtensor(x) -> bool:
+    return isinstance(x, QTensor)
+
+
+def quantize_leaf(w: jnp.ndarray) -> QTensor:
+    """Symmetric per-channel int8 over the last (output-channel) axis."""
+    w = jnp.asarray(w, jnp.float32)
+    axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+def _should_quantize(path, leaf, min_size: int) -> bool:
+    names = {getattr(p, "key", getattr(p, "name", None)) for p in path}
+    is_weight = bool(names & {"kernel", "embedding"})
+    return (is_weight and getattr(leaf, "ndim", 0) >= 2
+            and leaf.size >= min_size)
+
+
+def quantize_params(params: Any, min_size: int = 4096) -> Any:
+    """Quantize the matmul weights of a param tree; other leaves pass
+    through unchanged.  Returns a tree with ``QTensor`` leaves."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: quantize_leaf(leaf)
+        if _should_quantize(path, leaf, min_size) else leaf, params)
+
+
+def dequantize_params(qparams: Any, dtype=jnp.float32) -> Any:
+    """Rebuild a dense param tree (jit-safe: inside a compiled program the
+    dequant multiply fuses into each weight's consumer)."""
+    return jax.tree.map(
+        lambda l: l.dequantize(dtype) if _is_qtensor(l) else l,
+        qparams, is_leaf=_is_qtensor)
+
+
+def quantization_error(params: Any, qparams: Any) -> float:
+    """Max relative per-tensor L2 error across quantized leaves (sanity
+    metric: int8 per-channel is typically < 1%)."""
+    errs = []
+
+    def visit(orig, q):
+        if _is_qtensor(q):
+            w = np.asarray(orig, np.float64)
+            d = np.asarray(q.dequantize(jnp.float32), np.float64)
+            denom = np.linalg.norm(w) or 1.0
+            errs.append(np.linalg.norm(w - d) / denom)
+
+    # tree.map flattens against params' structure and extracts the matching
+    # qparams subtree per leaf, so QTensors arrive whole as `q`
+    jax.tree.map(visit, params, qparams)
+    return float(max(errs)) if errs else 0.0
+
+
+def param_nbytes(tree: Any) -> int:
+    """Total stored bytes of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree.leaves(tree, is_leaf=_is_qtensor):
+        if _is_qtensor(leaf):
+            total += leaf.q.size * 1 + leaf.scale.size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total
