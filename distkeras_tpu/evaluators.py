@@ -42,3 +42,100 @@ class AccuracyEvaluator(Evaluator):
 
     def evaluate(self, dataset: Dataset) -> float:
         return float(self._fn(jnp.asarray(dataset[self.prediction_col]), jnp.asarray(dataset[self.label_col])))
+
+
+def _to_index(col: jnp.ndarray) -> jnp.ndarray:
+    """Class-index or one-hot/probability column -> int32 class indices."""
+    if col.ndim > 1:
+        col = jnp.argmax(col, axis=-1)
+    return col.astype(jnp.int32)
+
+
+class TopKAccuracyEvaluator(Evaluator):
+    """Fraction of rows whose true class is in the top-k predictions.
+
+    Needs a vector prediction column (logits/probabilities); beyond the
+    reference surface (which had accuracy only), standard for the CIFAR/
+    ImageNet-style configs in BASELINE.md.
+    """
+
+    def __init__(self, k: int = 5, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.k = int(k)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+        def topk(pred, label):
+            if pred.ndim < 2:
+                raise ValueError("TopKAccuracyEvaluator needs a vector "
+                                 "prediction column (logits/probabilities)")
+            label = _to_index(label)
+            _, idx = jax.lax.top_k(pred, self.k)
+            return jnp.mean(jnp.any(idx == label[:, None], axis=-1).astype(jnp.float32))
+
+        self._fn = jax.jit(topk)
+
+    def evaluate(self, dataset: Dataset) -> float:
+        return float(self._fn(jnp.asarray(dataset[self.prediction_col]),
+                              jnp.asarray(dataset[self.label_col])))
+
+
+class ConfusionMatrixEvaluator(Evaluator):
+    """num_classes x num_classes counts: rows = true class, cols = predicted.
+
+    ``evaluate`` returns the matrix as a numpy int array (not a float) —
+    the building block for any derived metric.
+    """
+
+    def __init__(self, num_classes: int, prediction_col: str = "prediction_index",
+                 label_col: str = "label"):
+        self.num_classes = int(num_classes)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+        def confusion(pred, label):
+            pred, label = _to_index(pred), _to_index(label)
+            c = self.num_classes
+            # out-of-range indices (e.g. the common -1 "ignore" sentinel, or
+            # an index >= num_classes) must not clamp into bin 0 / vanish —
+            # route them to an overflow bin that is sliced off
+            valid = (pred >= 0) & (pred < c) & (label >= 0) & (label < c)
+            flat = jnp.where(valid, label * c + pred, c * c)
+            counts = jnp.bincount(flat, length=c * c + 1)
+            return counts[: c * c].reshape(c, c)
+
+        self._fn = jax.jit(confusion)
+
+    def evaluate(self, dataset: Dataset) -> np.ndarray:
+        return np.asarray(self._fn(jnp.asarray(dataset[self.prediction_col]),
+                                   jnp.asarray(dataset[self.label_col])))
+
+
+class PrecisionRecallF1Evaluator(Evaluator):
+    """Per-class precision/recall/F1 plus macro averages, from the
+    confusion matrix.  ``evaluate`` returns a dict:
+    ``{"precision": [C], "recall": [C], "f1": [C], "macro_precision": x,
+    "macro_recall": x, "macro_f1": x}`` (zero-division yields 0, the
+    sklearn ``zero_division=0`` convention).
+    """
+
+    def __init__(self, num_classes: int, prediction_col: str = "prediction_index",
+                 label_col: str = "label"):
+        self._confusion = ConfusionMatrixEvaluator(num_classes, prediction_col, label_col)
+
+    def evaluate(self, dataset: Dataset) -> dict:
+        cm = self._confusion.evaluate(dataset).astype(np.float64)
+        tp = np.diag(cm)
+        pred_tot = cm.sum(axis=0)
+        true_tot = cm.sum(axis=1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            precision = np.where(pred_tot > 0, tp / pred_tot, 0.0)
+            recall = np.where(true_tot > 0, tp / true_tot, 0.0)
+            denom = precision + recall
+            f1 = np.where(denom > 0, 2 * precision * recall / denom, 0.0)
+        return {
+            "precision": precision, "recall": recall, "f1": f1,
+            "macro_precision": float(precision.mean()),
+            "macro_recall": float(recall.mean()),
+            "macro_f1": float(f1.mean()),
+        }

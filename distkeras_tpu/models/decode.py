@@ -40,6 +40,26 @@ import jax.numpy as jnp
 from jax import lax
 
 from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.ops.quantize import QTensor
+
+
+def _wmul(eq: str, y: jnp.ndarray, w, dtype) -> jnp.ndarray:
+    """``einsum(eq, y, w)`` where ``w`` may be an int8 ``QTensor``.
+
+    The per-OUTPUT-channel scale commutes out of the contraction
+    (``einsum(y, q * s) == einsum(y, q) * s`` when ``s`` varies only along
+    the kernel's last, non-contracted axis), so the weight is consumed as
+    int8 — the convert fuses into the matmul's operand read and the scale
+    multiply into its epilogue, keeping per-step HBM weight traffic at 1
+    byte/elem instead of materializing an f32 copy outside the decode loop.
+    Every block kernel here (qkv [E,3,H,Dh], proj [H,Dh,E], up [E,F],
+    down [F,E]) has its channel axis last and uncontracted; the embedding
+    does NOT (``attend`` contracts E), so it is dequantized once up front.
+    """
+    if isinstance(w, QTensor):
+        out = jnp.einsum(eq, y, w.q.astype(dtype))
+        return out * w.scale.reshape(-1).astype(dtype)
+    return jnp.einsum(eq, y, w.astype(dtype))
 
 
 class KVCache(NamedTuple):
@@ -76,7 +96,7 @@ def _block(pb: dict, x: jnp.ndarray, k_all: jnp.ndarray, v_all: jnp.ndarray,
     head_dim = k_all.shape[-1]
 
     y = _layer_norm(pb["LayerNorm_0"], x, dtype)
-    qkv = jnp.einsum("ble,eshd->blshd", y, pb["qkv"]["kernel"].astype(dtype))
+    qkv = _wmul("ble,eshd->blshd", y, pb["qkv"]["kernel"], dtype)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     k_all = lax.dynamic_update_slice(
         k_all, k.astype(k_all.dtype)[None], (layer, 0, start_pos, 0, 0))
@@ -92,12 +112,12 @@ def _block(pb: dict, x: jnp.ndarray, k_all: jnp.ndarray, v_all: jnp.ndarray,
     scores = jnp.where(k_pos <= q_pos, scores, float("-inf"))
     attn = jax.nn.softmax(scores, axis=-1).astype(dtype)
     o = jnp.einsum("bhqk,bkhd->bqhd", attn, cv)
-    o = jnp.einsum("bqhd,hde->bqe", o, pb["proj"]["kernel"].astype(dtype))
+    o = _wmul("bqhd,hde->bqe", o, pb["proj"]["kernel"], dtype)
     x = x + o
 
     y = _layer_norm(pb["LayerNorm_1"], x, dtype)
-    y = jax.nn.gelu(jnp.einsum("ble,ef->blf", y, pb["up"]["kernel"].astype(dtype)))
-    y = jnp.einsum("blf,fe->ble", y, pb["down"]["kernel"].astype(dtype))
+    y = jax.nn.gelu(_wmul("ble,ef->blf", y, pb["up"]["kernel"], dtype))
+    y = _wmul("blf,fe->ble", y, pb["down"]["kernel"], dtype)
     return x + y, k_all, v_all
 
 
@@ -176,6 +196,15 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
 
     @functools.partial(jax.jit, static_argnames=("prompt_len",))
     def run(params, prompt, rng, prompt_len):
+        # int8 trees (ops/quantize.py) decode transparently: block kernels
+        # are consumed as int8 per use via _wmul (the scale commutes out of
+        # each matmul), so per-step weight traffic stays at 1 byte/elem.
+        # Only the embedding dequantizes up front — its scale axis (E) is
+        # contracted by the unembed, so the scale does not commute there.
+        emb = params["embed"]["embedding"]
+        if isinstance(emb, QTensor):
+            params = dict(params,
+                          embed={"embedding": emb.dequantize(jnp.float32)})
         total = cache_len or (prompt_len + max_new_tokens)
         if prompt_len + max_new_tokens > total:
             raise ValueError(
@@ -255,6 +284,14 @@ def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
                          f"by tp={tp} over mesh axis {tp_axis!r}")
 
     def fn(params, prompt, rng=None):
+        from distkeras_tpu.ops.quantize import QTensor
+
+        if any(isinstance(l, QTensor) for l in jax.tree.leaves(
+                params, is_leaf=lambda l: isinstance(l, QTensor))):
+            raise ValueError("int8-quantized trees are not supported with "
+                             "sharded decoding (v1): per-channel scale dims "
+                             "don't carry the Megatron partition specs; use "
+                             "make_generate_fn (single-program) instead")
         if dp_axis and prompt.shape[0] % mesh.shape[dp_axis]:
             raise ValueError(f"batch {prompt.shape[0]} not divisible by "
                              f"dp={mesh.shape[dp_axis]}")

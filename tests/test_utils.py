@@ -53,3 +53,70 @@ def test_shuffle_arrays_consistent_permutation():
 def test_shuffle_arrays_rejects_mismatched():
     with pytest.raises(ValueError):
         utils.shuffle_arrays({"x": np.arange(3), "y": np.arange(4)})
+
+
+class TestEvaluatorSuite:
+    """Top-k / confusion / precision-recall-F1 vs hand-computed values."""
+
+    def _ds(self):
+        import numpy as _np
+
+        from distkeras_tpu.data.dataset import Dataset as _DS
+
+        logits = _np.array([[3.0, 2.0, 1.0],   # top1=0 top2={0,1}
+                            [1.0, 3.0, 2.0],   # top1=1 top2={1,2}
+                            [1.0, 2.0, 3.0],   # top1=2 top2={2,1}
+                            [2.0, 3.0, 1.0]])  # top1=1 top2={1,0}
+        labels = _np.array([0, 2, 2, 1])
+        pred_idx = logits.argmax(1)
+        return _DS({"prediction": logits.astype(_np.float32),
+                    "prediction_index": pred_idx.astype(_np.int64),
+                    "label": labels.astype(_np.int64)})
+
+    def test_topk(self):
+        from distkeras_tpu.evaluators import TopKAccuracyEvaluator
+
+        ds = self._ds()
+        assert TopKAccuracyEvaluator(k=1).evaluate(ds) == pytest.approx(0.75)
+        assert TopKAccuracyEvaluator(k=2).evaluate(ds) == pytest.approx(1.0)
+
+    def test_confusion(self):
+        import numpy as _np
+
+        from distkeras_tpu.evaluators import ConfusionMatrixEvaluator
+
+        cm = ConfusionMatrixEvaluator(3).evaluate(self._ds())
+        want = _np.zeros((3, 3), int)
+        want[0, 0] += 1  # true 0 pred 0
+        want[2, 1] += 1  # true 2 pred 1
+        want[2, 2] += 1  # true 2 pred 2
+        want[1, 1] += 1  # true 1 pred 1
+        _np.testing.assert_array_equal(cm, want)
+
+    def test_confusion_ignores_out_of_range_indices(self):
+        import numpy as _np
+
+        from distkeras_tpu.data.dataset import Dataset as _DS
+        from distkeras_tpu.evaluators import ConfusionMatrixEvaluator
+
+        ds = _DS({"prediction_index": _np.array([0, 1, 0, 2]),
+                  "label": _np.array([-1, 1, 3, 2])})  # -1 ignore, 3 OOB
+        cm = ConfusionMatrixEvaluator(3).evaluate(ds)
+        want = _np.zeros((3, 3), int)
+        want[1, 1] = 1
+        want[2, 2] = 1
+        _np.testing.assert_array_equal(cm, want)
+
+    def test_prf1(self):
+        from distkeras_tpu.evaluators import PrecisionRecallF1Evaluator
+
+        m = PrecisionRecallF1Evaluator(3).evaluate(self._ds())
+        # class 1: tp=1, predicted={1,1} twice -> precision 0.5, true once -> recall 1
+        assert m["precision"][1] == pytest.approx(0.5)
+        assert m["recall"][1] == pytest.approx(1.0)
+        assert m["f1"][1] == pytest.approx(2 / 3)
+        # class 0: perfect
+        assert m["f1"][0] == pytest.approx(1.0)
+        # class 2: tp=1, pred once -> precision 1, true twice -> recall .5
+        assert m["f1"][2] == pytest.approx(2 / 3)
+        assert m["macro_f1"] == pytest.approx((1.0 + 2 / 3 + 2 / 3) / 3)
