@@ -284,8 +284,6 @@ def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
                          f"by tp={tp} over mesh axis {tp_axis!r}")
 
     def fn(params, prompt, rng=None):
-        from distkeras_tpu.ops.quantize import QTensor
-
         if any(isinstance(l, QTensor) for l in jax.tree.leaves(
                 params, is_leaf=lambda l: isinstance(l, QTensor))):
             raise ValueError("int8-quantized trees are not supported with "

@@ -57,17 +57,20 @@ _STAT_LANES = 8  # trailing lanes for per-row stats (min f32 tile lane count
 class _Config(NamedTuple):
     """Static kernel configuration (hashable: custom_vjp nondiff argument).
 
-    Forward and backward may use different block sizes: the dkv kernel
-    carries ~2x the VMEM working set of the forward (two f32 scratch
-    accumulators + dO tiles), so the forward can afford (1024, 1024) where
-    the backward must stay at (512, 1024) to fit scoped vmem inside full
-    transformer programs."""
+    Three block pairs: forward, dq, and dkv.  The dq kernel streams kv
+    blocks like the forward and by default shares its blocks; the dkv
+    kernel carries the largest VMEM working set (two outputs + two f32
+    scratch accumulators) and needs smaller defaults — (1024, 1024) dkv
+    lands 8K over the 16M scoped-vmem limit inside full transformer
+    backward programs where the same blocks compile fine for fwd/dq."""
 
     causal: bool
     q_offset: int
     k_offset: int
     block_q: int
     block_k: int
+    block_q_dq: int
+    block_k_dq: int
     block_q_bwd: int
     block_k_bwd: int
     interpret: bool
@@ -150,7 +153,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, cfg: _Config, scale: float):
     qi, kj = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
-    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
+    bq, bk = cfg.block_q_dq, cfg.block_k_dq
 
     @pl.when(kj == 0)
     def _init():
@@ -254,7 +257,8 @@ def _forward(q, k, v, cfg: _Config):
 def _backward(q, k, v, o, lse, do, cfg: _Config):
     b, h, lq, d = q.shape
     lk = k.shape[2]
-    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
+    bq, bk = cfg.block_q_dq, cfg.block_k_dq
+    bq_kv, bk_kv = cfg.block_q_bwd, cfg.block_k_bwd
     scale = 1.0 / (d ** 0.5)
     # delta[b, h, i] = sum_d dO * O — the softmax-jacobian row term; tiny
     # elementwise reduce, XLA fuses it, no kernel needed
@@ -280,26 +284,26 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, cfg=cfg, scale=scale),
-        grid=(b, h, lk // bk, lq // bq),
+        grid=(b, h, lk // bk_kv, lq // bq_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),   # q
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),   # k
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),   # v
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),   # do
-            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # lse
-            pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # delta
+            pl.BlockSpec((1, 1, bq_kv, d), lambda b, h, j, i: (b, h, i, 0)),   # q
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # k
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # v
+            pl.BlockSpec((1, 1, bq_kv, d), lambda b, h, j, i: (b, h, i, 0)),   # do
+            pl.BlockSpec((1, 1, bq_kv, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # lse
+            pl.BlockSpec((1, 1, bq_kv, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk_kv, d), jnp.float32),
+            pltpu.VMEM((bk_kv, d), jnp.float32),
         ],
         interpret=cfg.interpret,
     )(q, k, v, do, lse, delta)
@@ -341,20 +345,23 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Flash attention over [B, L, H, D] tensors (same layout/semantics as
     ``ops.attention.dense_attention``, including the shard offsets).
 
-    Forward and backward kernels take independent block sizes.  Defaults
-    (v5e sweeps, 2026-07-30): the forward auto-selects ``block_q`` 1024 at
-    >= 16k tokens (~14% faster at 32k) and 512 below; the auto backward
-    stays at (512, ``block_k``) because the dkv kernel's working set at
+    Three kernels, three block pairs.  Defaults (v5e sweeps, 2026-07-30):
+    the forward auto-selects ``block_q`` 1024 at >= 16k tokens and 512
+    below; the dq pass shares the forward blocks (same kv-streaming shape,
+    one scratch — measured to compile at (1024, 1024) inside full 32k LM
+    backward programs, together worth ~7% on the 32k train step); the dkv
+    pass defaults to (512, ``block_k``) because its working set at
     (1024, 1024) lands 8K over the 16M scoped-vmem limit inside full
     transformer backward programs.  (512, 1024) is within ~7% of peak at
     2k/8k; small blocks lose badly (128 runs at 0.4x dense).
 
-    Explicit ``block_q``/``block_k`` are inherited by the backward unless
-    ``block_q_bwd``/``block_k_bwd`` override them — so callers tuning
-    blocks (to fix a scoped-vmem overflow, or to use a full-length block
-    on a non-8-divisible sequence) control both passes with one knob.
-    ``_pick_block`` shrinks every block to fit short sequences
-    automatically.
+    Explicit knobs override: ``block_q``/``block_k`` govern the forward
+    AND (absent bwd overrides) both backward kernels, so one knob tunes
+    everything — e.g. a full-length block on a non-8-divisible sequence,
+    or shrinking all passes out of a scoped-vmem overflow.  Explicit
+    ``block_q_bwd``/``block_k_bwd`` pin both backward kernels (dq and
+    dkv) regardless of the forward.  ``_pick_block`` shrinks every block
+    to fit short sequences automatically.
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     identical kernel code runs (slowly) in CPU tests.
@@ -362,18 +369,26 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
-    if block_q_bwd is None:
-        # inherit an explicit forward block; the 16k auto-upgrade must NOT
-        # propagate (1024-block bwd is the scoped-vmem overflow)
-        block_q_bwd = 512 if block_q is None else block_q
-    if block_k_bwd is None:
-        block_k_bwd = block_k
+    explicit_fwd_q = block_q is not None
     if block_q is None:
         block_q = 1024 if lq >= 16384 else 512
+    if block_q_bwd is None:
+        # dkv default: 512, or an explicitly-chosen forward block (the 16k
+        # auto-upgrade must NOT reach dkv — 1024 is its scoped-vmem overflow)
+        dkv_q = block_q if explicit_fwd_q else 512
+        dq_q = block_q  # dq tracks the forward, auto-upgrade included
+    else:
+        dq_q = dkv_q = block_q_bwd
+    if block_k_bwd is None:
+        dq_k = dkv_k = block_k
+    else:
+        dq_k = dkv_k = block_k_bwd
     bq, bk = _pick_block(block_q, lq), _pick_block(block_k, lk)
-    bq_b, bk_b = _pick_block(block_q_bwd, lq), _pick_block(block_k_bwd, lk)
+    bq_dq, bk_dq = _pick_block(dq_q, lq), _pick_block(dq_k, lk)
+    bq_kv, bk_kv = _pick_block(dkv_q, lq), _pick_block(dkv_k, lk)
     for name, blk, length in (("block_q", bq, lq), ("block_k", bk, lk),
-                              ("block_q_bwd", bq_b, lq), ("block_k_bwd", bk_b, lk)):
+                              ("block_q_dq", bq_dq, lq), ("block_k_dq", bk_dq, lk),
+                              ("block_q_bwd", bq_kv, lq), ("block_k_bwd", bk_kv, lk)):
         # Mosaic tiling: the sublane block dim must be 8-divisible or span
         # the whole array dim (interpret mode is lenient, but keep semantics
         # identical so CPU tests catch what TPU would reject)
@@ -384,7 +399,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 f"8-divisible nor the full length; pad the sequence or use "
                 f"impl='dense'")
     cfg = _Config(causal=bool(causal), q_offset=int(q_offset), k_offset=int(k_offset),
-                  block_q=bq, block_k=bk, block_q_bwd=bq_b, block_k_bwd=bk_b,
+                  block_q=bq, block_k=bk, block_q_dq=bq_dq, block_k_dq=bk_dq,
+                  block_q_bwd=bq_kv, block_k_bwd=bk_kv,
                   interpret=bool(interpret))
     # [B, L, H, D] -> [B, H, L, D] for the kernels; the transposes sit outside
     # the custom_vjp so their adjoints are handled by XLA

@@ -175,6 +175,9 @@ def test_quantized_tree_decodes_and_matches(model):
     q = np.asarray(fn(qp, prompt))
     assert q.shape == full.shape
     assert ((q >= 0) & (q < 61)).all()
+    # int8 error is tiny on this f32 model: the greedy path must track the
+    # full-precision tokens closely, or the scale broadcasting is wrong
+    assert (q == full).mean() >= 0.75, f"int8 tokens diverged: {q} vs {full}"
     from distkeras_tpu.models.decode import make_sharded_generate_fn
     from distkeras_tpu.parallel.mesh import create_nd_mesh
 
