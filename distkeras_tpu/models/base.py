@@ -165,3 +165,31 @@ class Model:
 
     def copy(self) -> "Model":
         return Model(spec=self.spec, params=jax.tree.map(jnp.array, self.params))
+
+    def summary(self) -> str:
+        """Keras ``model.summary()`` parity: per-module parameter table.
+
+        Groups leaves by top-level param-tree key (one row per layer/block),
+        with shapes for single-leaf modules and totals throughout.
+        """
+        rows = []
+        total = total_bytes = 0
+        for name, sub in self.params.items():
+            leaves = jax.tree.leaves(sub)
+            n = sum(int(l.size) for l in leaves)
+            nbytes = sum(int(l.size) * l.dtype.itemsize for l in leaves)
+            shape = str(tuple(leaves[0].shape)) if len(leaves) == 1 else f"{len(leaves)} tensors"
+            rows.append((name, shape, n))
+            total += n
+            total_bytes += nbytes
+        name_w = max([5] + [len(r[0]) for r in rows])   # >= len("layer")
+        shape_w = max([5] + [len(r[1]) for r in rows])  # >= len("shape")
+        lines = [f'Model "{self.spec.name}"  (input {self.spec.input_shape}, '
+                 f'{self.spec.input_dtype})',
+                 f"{'layer':<{name_w}}  {'shape':<{shape_w}}  params"]
+        lines.append("-" * len(lines[-1]))
+        for name, shape, n in rows:
+            lines.append(f"{name:<{name_w}}  {shape:<{shape_w}}  {n:,}")
+        lines.append("-" * len(lines[1]))
+        lines.append(f"total: {total:,} params  ({total_bytes / 1e6:.2f} MB)")
+        return "\n".join(lines)

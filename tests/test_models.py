@@ -77,3 +77,16 @@ def test_transformer_remat_matches_non_remat():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_model_summary():
+    import jax
+
+    from distkeras_tpu.models.transformer import small_lm_spec
+
+    m = Model.init(small_lm_spec(vocab_size=64, model_dim=32, num_heads=2,
+                                 num_layers=2, max_seq_len=16), seed=0)
+    s = m.summary()
+    assert "block_0" in s and "embed" in s and "total:" in s
+    want = sum(int(l.size) for l in jax.tree.leaves(m.params))
+    assert f"{want:,} params" in s
