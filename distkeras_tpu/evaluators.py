@@ -32,11 +32,7 @@ class AccuracyEvaluator(Evaluator):
         self.label_col = label_col
 
         def acc(pred, label):
-            if pred.ndim > 1:
-                pred = jnp.argmax(pred, axis=-1)
-            if label.ndim > 1:
-                label = jnp.argmax(label, axis=-1)
-            return jnp.mean((pred.astype(jnp.int32) == label.astype(jnp.int32)).astype(jnp.float32))
+            return jnp.mean((_to_index(pred) == _to_index(label)).astype(jnp.float32))
 
         self._fn = jax.jit(acc)
 
@@ -45,8 +41,16 @@ class AccuracyEvaluator(Evaluator):
 
 
 def _to_index(col: jnp.ndarray) -> jnp.ndarray:
-    """Class-index or one-hot/probability column -> int32 class indices."""
-    if col.ndim > 1:
+    """Class-index or one-hot/probability column -> int32 class indices.
+
+    A trailing size-1 axis is an index column wearing a column shape
+    ((N, 1) from dataframe-style sources), NOT a one-class one-hot —
+    argmax over it would collapse every row to 0.  Integer arrays are
+    ALWAYS indices whatever their rank ((B, T) token labels stay (B, T));
+    only float arrays argmax over the class axis."""
+    if col.ndim > 1 and col.shape[-1] == 1:
+        col = col[..., 0]
+    if col.ndim > 1 and not jnp.issubdtype(col.dtype, jnp.integer):
         col = jnp.argmax(col, axis=-1)
     return col.astype(jnp.int32)
 

@@ -152,7 +152,14 @@ class AsyncDistributedTrainer(Trainer):
             next_step[0] += 1
 
     # -- training --------------------------------------------------------------
-    def train(self, dataset: Dataset, shuffle: bool = True, checkpointer=None) -> Model:
+    def train(self, dataset: Dataset, shuffle: bool = True, checkpointer=None,
+              validation_data: Optional[Dataset] = None) -> Model:
+        if validation_data is not None:
+            raise ValueError(
+                "per-epoch validation is not supported for async trainers "
+                "(workers race the hub; there is no synchronized epoch "
+                "boundary to score) — evaluate the returned model, or use "
+                "the sync trainer family")
         if checkpointer is not None and self.ps_address is None:
             # restore only when WE own the hub: in worker-only mode the
             # external hub's center wins (workers pull it immediately), so

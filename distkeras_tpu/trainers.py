@@ -87,7 +87,8 @@ class Trainer:
         return end - self._t_start
 
     def train(self, dataset: Dataset, shuffle: bool = True,
-              checkpointer: Optional[Checkpointer] = None) -> Model:  # pragma: no cover - interface
+              checkpointer: Optional[Checkpointer] = None,
+              validation_data: Optional[Dataset] = None) -> Model:  # pragma: no cover - interface
         raise NotImplementedError
 
     def _profile_ctx(self):
@@ -98,6 +99,86 @@ class Trainer:
 
             return contextlib.nullcontext()
         return jax.profiler.trace(self.profile_dir)
+
+    _VAL_BATCH = 1024  # validation chunk rows: bounds device residency for
+                       # big validation sets (two static shapes per run: the
+                       # full chunk and one remainder)
+
+    def _validate(self, params, validation_data: Optional[Dataset]) -> Optional[dict]:
+        """Per-epoch validation: loss (always) + accuracy (classification
+        labels only).  Evaluated in bounded chunks; the jitted evaluator is
+        cached per classification-mode, so reusing one trainer across
+        classification and regression validation sets stays correct."""
+        if validation_data is None:
+            return None
+        y_host = validation_data[self.label_col]
+        # accuracy only for classification labels: integer class indices, or
+        # float rows that are actually one-hot (a float vector target that
+        # isn't one-hot is regression — argmax "accuracy" would be noise).
+        # A trailing size-1 axis is an index column, not a one-class one-hot.
+        y_probe = y_host[..., 0] if (y_host.ndim > 1 and y_host.shape[-1] == 1) else y_host
+        if np.issubdtype(y_probe.dtype, np.integer):
+            classify = True
+        elif y_probe.ndim > 1:
+            sample = np.asarray(y_probe[:256])
+            classify = bool(np.all((sample == 0) | (sample == 1))
+                            and np.allclose(sample.sum(axis=-1), 1))
+        else:
+            classify = False
+        fns = getattr(self, "_val_fns", None)
+        if fns is None:
+            fns = {}
+            self._val_fns = fns
+        if classify not in fns:
+            apply = self.model.spec.apply_fn()
+            loss = self.loss
+            want_acc = classify
+
+            @jax.jit
+            def val(params, x, y):
+                from distkeras_tpu.evaluators import _to_index
+
+                logits = apply(params, x)
+                out = {"loss_sum": loss(logits, y) * x.shape[0]}
+                if want_acc:
+                    if logits.ndim > 1 and logits.shape[-1] == 1:
+                        pred = (logits[..., 0] > 0).astype(jnp.int32)  # single-logit binary
+                    elif logits.ndim == 1:
+                        pred = (logits > 0).astype(jnp.int32)
+                    else:
+                        pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    idx = _to_index(y)
+                    # shapes are static at trace time: token-level labels
+                    # ((B, T) ints vs (B, T) preds) count every element;
+                    # incompatible label/logit shapes drop accuracy rather
+                    # than report a broadcasting accident
+                    if pred.shape == idx.shape:
+                        out["correct"] = jnp.sum((pred == idx).astype(jnp.float32))
+                        out["acc_denom"] = jnp.asarray(float(pred.size), jnp.float32)
+                return out
+
+            fns[classify] = val
+        fn = fns[classify]
+        x_host = validation_data[self.features_col]
+        n = len(x_host)
+        if n == 0:
+            raise ValueError("validation_data is empty — 0-row validation "
+                             "would silently report val_loss 0.0")
+        loss_sum = correct = denom = 0.0
+        have_acc = classify
+        for i in range(0, n, self._VAL_BATCH):
+            out = fn(params, jnp.asarray(x_host[i:i + self._VAL_BATCH]),
+                     jnp.asarray(y_host[i:i + self._VAL_BATCH]))
+            loss_sum += float(out["loss_sum"])
+            if "correct" in out:
+                correct += float(out["correct"])
+                denom += float(out["acc_denom"])
+            else:
+                have_acc = False
+        result = {"val_loss": loss_sum / n}
+        if have_acc and denom > 0:
+            result["val_accuracy"] = correct / denom
+        return result
 
     def _record_epoch_metrics(self, epoch: int, samples: int, seconds: float,
                               chips: int = 1) -> None:
@@ -125,7 +206,8 @@ class SingleTrainer(Trainer):
     """
 
     def train(self, dataset: Dataset, shuffle: bool = True,
-              checkpointer: Optional[Checkpointer] = None) -> Model:
+              checkpointer: Optional[Checkpointer] = None,
+              validation_data: Optional[Dataset] = None) -> Model:
         self.record_training_start()
         # cached across train() calls: scan_epoch_fn returns a fresh jit
         # closure each time, which would defeat the jit cache and recompile
@@ -165,6 +247,9 @@ class SingleTrainer(Trainer):
                     self.history.extend(np.asarray(losses).tolist())
                     samples += xs.shape[0] * xs.shape[1]
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch, chips=1)
+                val = self._validate(params, validation_data)
+                if val:
+                    self.metrics[-1].update(val)
                 if checkpointer is not None:
                     checkpointer.save(epoch + 1, {"params": params, "opt_state": opt_state},
                                       metadata={"epochs_done": epoch + 1})
@@ -209,8 +294,15 @@ class DistributedTrainer(Trainer):
             )
         return self._engine
 
+    def _validation_params(self, state):
+        """Params the per-epoch validation should score — the center for
+        PS-style trainers; overridden where the center is not the artifact
+        (AveragingTrainer scores the average of the replicas)."""
+        return self.engine.center_model(state).params
+
     def _run_epochs(self, dataset: Dataset, shuffle: bool,
-                    checkpointer: Optional[Checkpointer] = None) -> Any:
+                    checkpointer: Optional[Checkpointer] = None,
+                    validation_data: Optional[Dataset] = None) -> Any:
         engine = self.engine
         state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
         start_epoch = 0
@@ -237,15 +329,20 @@ class DistributedTrainer(Trainer):
                                 * self.communication_window * global_batch)
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
                                            chips=self.num_workers)
+                if validation_data is not None:
+                    val = self._validate(self._validation_params(state),
+                                         validation_data)
+                    self.metrics[-1].update(val)
                 if checkpointer is not None:
                     checkpointer.save(epoch + 1, {"state": state},
                                       metadata={"epochs_done": epoch + 1})
         return state
 
     def train(self, dataset: Dataset, shuffle: bool = True,
-              checkpointer: Optional[Checkpointer] = None) -> Model:
+              checkpointer: Optional[Checkpointer] = None,
+              validation_data: Optional[Dataset] = None) -> Model:
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle, checkpointer)
+        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data)
         self.model = self.engine.center_model(state)
         self.record_training_end()
         return self.model
@@ -311,10 +408,16 @@ class AveragingTrainer(DistributedTrainer):
     def allocate_algorithm(self) -> Algorithm:
         return NoCommitAlgorithm()
 
+    def _validation_params(self, state):
+        # NoCommit leaves the center at init; the meaningful per-epoch
+        # artifact is the average of the replicas
+        return self.engine.averaged_model(state).params
+
     def train(self, dataset: Dataset, shuffle: bool = True,
-              checkpointer: Optional[Checkpointer] = None) -> Model:
+              checkpointer: Optional[Checkpointer] = None,
+              validation_data: Optional[Dataset] = None) -> Model:
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle, checkpointer)
+        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data)
         self.model = self.engine.averaged_model(state)
         self.record_training_end()
         return self.model
@@ -341,7 +444,13 @@ class EnsembleTrainer(DistributedTrainer):
         return [self.seed + 1000 + i for i in range(self.num_workers)]
 
     def train(self, dataset: Dataset, shuffle: bool = True,
-              checkpointer: Optional[Checkpointer] = None) -> List[Model]:  # type: ignore[override]
+              checkpointer: Optional[Checkpointer] = None,
+              validation_data: Optional[Dataset] = None) -> List[Model]:  # type: ignore[override]
+        if validation_data is not None:
+            raise ValueError(
+                "per-epoch validation is ambiguous for an ensemble (N "
+                "independent members, no single center); evaluate the "
+                "returned models with ModelPredictor/AccuracyEvaluator")
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer)
         models = self.engine.local_models(state)

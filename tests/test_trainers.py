@@ -155,3 +155,92 @@ def test_async_rejects_non_float32_params():
     t = AsyncDOWNPOUR(m, num_workers=1, batch_size=16, num_epoch=1)
     with _pytest.raises(TypeError, match="float32"):
         t.train(ds)
+
+
+def test_validation_data_records_per_epoch_metrics():
+    import numpy as _np
+
+    from distkeras_tpu.data.dataset import Dataset as _DS
+    from distkeras_tpu.models.base import ModelSpec as _MS
+    from distkeras_tpu.trainers import ADAG as _ADAG, SingleTrainer as _ST
+
+    rng = _np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(_np.float32)
+    w = rng.normal(size=(8, 3)).astype(_np.float32)
+    labels = _np.argmax(x @ w, axis=1)
+    onehot = _np.eye(3, dtype=_np.float32)[labels]
+    train = _DS({"features": x[:96], "label": onehot[:96]})
+    val = _DS({"features": x[96:], "label": onehot[96:]})
+    spec = _MS(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 3},
+               input_shape=(8,))
+
+    tr = _ST(spec, batch_size=32, num_epoch=3, learning_rate=0.1)
+    tr.train(train, validation_data=val)
+    assert len(tr.metrics) == 3
+    assert all("val_loss" in m and "val_accuracy" in m for m in tr.metrics)
+    # training on a separable task: val accuracy must improve over random
+    assert tr.metrics[-1]["val_accuracy"] > 0.5
+    assert tr.metrics[-1]["val_loss"] < tr.metrics[0]["val_loss"]
+
+    tr2 = _ADAG(spec, num_workers=8, batch_size=4, num_epoch=2,
+                communication_window=2, learning_rate=0.1)
+    tr2.train(train, validation_data=val)
+    assert all("val_loss" in m for m in tr2.metrics)
+
+    # regression labels (float vector targets): loss only, no accuracy
+    reg = _DS({"features": x[:96], "label": (x[:96] @ w).astype(_np.float32)})
+    regval = _DS({"features": x[96:], "label": (x[96:] @ w).astype(_np.float32)})
+    spec_r = _MS(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 3},
+                 input_shape=(8,))
+    tr3 = _ST(spec_r, loss="mse", batch_size=32, num_epoch=1, learning_rate=0.01)
+    tr3.train(reg, validation_data=regval)
+    assert "val_loss" in tr3.metrics[-1]
+    assert "val_accuracy" not in tr3.metrics[-1]
+
+    # (N, 1) integer index labels must not argmax-collapse to class 0
+    idx = _DS({"features": x[:96], "label": labels[:96].reshape(-1, 1)})
+    idxval = _DS({"features": x[96:], "label": labels[96:].reshape(-1, 1)})
+    tr4 = _ST(spec, loss="sparse_categorical_crossentropy",
+              batch_size=32, num_epoch=3, learning_rate=0.1)
+    # sparse CE wants [N] int labels; reshape col inside a wrapper loss
+    import jax.numpy as _jnp
+    from distkeras_tpu.ops.losses import get_loss as _gl
+    sce = _gl("sparse_categorical_crossentropy")
+    tr4.loss = lambda logits, y: sce(logits, y.reshape(-1))
+    tr4.train(idx, validation_data=idxval)
+    assert tr4.metrics[-1]["val_accuracy"] > 0.5
+
+    # averaging trainer validates the averaged model; ensemble refuses
+    from distkeras_tpu.trainers import AveragingTrainer as _AT, EnsembleTrainer as _ET
+    tr5 = _AT(spec, num_workers=8, batch_size=4, num_epoch=1, learning_rate=0.1)
+    tr5.train(train, validation_data=val)
+    assert "val_accuracy" in tr5.metrics[-1]
+    with pytest.raises(ValueError, match="ambiguous"):
+        _ET(spec, num_workers=8, batch_size=4, num_epoch=1).train(
+            train, validation_data=val)
+
+    # token-level (B, T) int labels: accuracy counts tokens, not rows
+    from distkeras_tpu.models.transformer import small_lm_spec as _lm
+    lm_spec = _lm(vocab_size=16, model_dim=16, num_heads=2, num_layers=1,
+                  max_seq_len=8)
+    lm_spec.config["compute_dtype"] = "float32"
+    toks = rng.integers(0, 16, (32, 8)).astype(_np.int32)
+    tgts = _np.roll(toks, -1, axis=1).astype(_np.int32)
+    lm_ds = _DS({"features": toks, "label": tgts})
+    tr6 = _ST(lm_spec, loss=lambda logits, y: _optax_sce(logits, y),
+              batch_size=8, num_epoch=1, learning_rate=0.01)
+    tr6.train(lm_ds, validation_data=lm_ds)
+    assert 0.0 <= tr6.metrics[-1]["val_accuracy"] <= 1.0
+
+    # empty validation set is a loud error, not a fake perfect score
+    with pytest.raises(ValueError, match="empty"):
+        _ST(spec, batch_size=32, num_epoch=1).train(
+            train, validation_data=_DS({"features": x[:0], "label": onehot[:0]}))
+
+
+def _optax_sce(logits, y):
+    import jax.numpy as _jnp
+    import optax as _optax
+
+    return _optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(_jnp.float32), y).mean()
