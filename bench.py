@@ -357,8 +357,13 @@ def main() -> None:
         out["vs_baseline"] = round(vs, 6)
 
         if platform == "tpu":
+            import gc
+
             # secondary benches are TPU-only (flash is a Mosaic kernel) and
-            # individually fallible — a failure is recorded, not fatal
+            # individually fallible — a failure is recorded, not fatal.
+            # gc between legs drops dead device buffers promptly: HBM
+            # pressure from earlier legs once blew the 32k LM leg up 25x
+            gc.collect()
             lm, attn = [], []
             # steps sized so per-step relay overhead (~100ms/dispatch) stays
             # under ~3% of the reported ms_per_step at each length
@@ -370,11 +375,13 @@ def main() -> None:
                     lm.append(_bench_lm(seq, batch, steps=steps))
                 except Exception as e:
                     lm.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
+                gc.collect()
             for seq, steps in ((2048, 50), (8192, 25)):
                 try:
                     attn.append(_bench_attn(seq, steps=steps))
                 except Exception as e:
                     attn.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
+                gc.collect()
             out["lm"] = lm
             out["attn"] = attn
             try:

@@ -59,6 +59,7 @@ _EXPORTS = {
     "ModelSpec": "distkeras_tpu.models.base",
     "generate": "distkeras_tpu.models.decode",
     "make_generate_fn": "distkeras_tpu.models.decode",
+    "make_speculative_generate_fn": "distkeras_tpu.models.speculative",
     "ModelPredictor": "distkeras_tpu.predictors",
     "AccuracyEvaluator": "distkeras_tpu.evaluators",
     "pin_cpu_devices": "distkeras_tpu.platform",

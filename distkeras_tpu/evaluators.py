@@ -32,7 +32,7 @@ class AccuracyEvaluator(Evaluator):
         self.label_col = label_col
 
         def acc(pred, label):
-            return jnp.mean((_to_index(pred) == _to_index(label)).astype(jnp.float32))
+            return jnp.mean((_pred_to_index(pred) == _to_index(label)).astype(jnp.float32))
 
         self._fn = jax.jit(acc)
 
@@ -52,6 +52,22 @@ def _to_index(col: jnp.ndarray) -> jnp.ndarray:
         col = col[..., 0]
     if col.ndim > 1 and not jnp.issubdtype(col.dtype, jnp.integer):
         col = jnp.argmax(col, axis=-1)
+    return col.astype(jnp.int32)
+
+
+def _pred_to_index(col: jnp.ndarray) -> jnp.ndarray:
+    """Model-output column -> int32 class indices.
+
+    Differs from ``_to_index`` on 1-D (or (N, 1)) FLOAT columns: a model's
+    scalar output is a single-logit binary score (class = logit > 0, the
+    raw-logit convention the trainers' validation path also uses), not a
+    float-coded class id — truncating 2.7 to class 2 would be noise."""
+    if col.ndim > 1 and col.shape[-1] == 1:
+        col = col[..., 0]
+    if col.ndim > 1:
+        col = jnp.argmax(col, axis=-1)
+    elif not jnp.issubdtype(col.dtype, jnp.integer):
+        col = col > 0
     return col.astype(jnp.int32)
 
 
@@ -98,7 +114,7 @@ class ConfusionMatrixEvaluator(Evaluator):
         self.label_col = label_col
 
         def confusion(pred, label):
-            pred, label = _to_index(pred), _to_index(label)
+            pred, label = _pred_to_index(pred), _to_index(label)
             c = self.num_classes
             # out-of-range indices (e.g. the common -1 "ignore" sentinel, or
             # an index >= num_classes) must not clamp into bin 0 / vanish —

@@ -62,6 +62,19 @@ def _wmul(eq: str, y: jnp.ndarray, w, dtype) -> jnp.ndarray:
     return jnp.einsum(eq, y, w.astype(dtype))
 
 
+def dequant_embed(params: Any) -> Any:
+    """int8 trees (ops/quantize.py) decode transparently: block kernels are
+    consumed as int8 per use via ``_wmul`` (the scale commutes out of each
+    matmul), so per-step weight traffic stays at 1 byte/elem.  Only the
+    embedding dequantizes up front — its scale axis (E) is contracted by
+    the unembed, so the scale does not commute there.  Shared prologue of
+    ``make_generate_fn`` and ``speculative.make_speculative_generate_fn``."""
+    emb = params["embed"]["embedding"]
+    if isinstance(emb, QTensor):
+        params = dict(params, embed={"embedding": emb.dequantize(jnp.float32)})
+    return params
+
+
 class KVCache(NamedTuple):
     """Stacked per-layer key/value cache: [num_layers, B, S, H, Dh]."""
 
@@ -196,15 +209,7 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
 
     @functools.partial(jax.jit, static_argnames=("prompt_len",))
     def run(params, prompt, rng, prompt_len):
-        # int8 trees (ops/quantize.py) decode transparently: block kernels
-        # are consumed as int8 per use via _wmul (the scale commutes out of
-        # each matmul), so per-step weight traffic stays at 1 byte/elem.
-        # Only the embedding dequantizes up front — its scale axis (E) is
-        # contracted by the unembed, so the scale does not commute there.
-        emb = params["embed"]["embedding"]
-        if isinstance(emb, QTensor):
-            params = dict(params,
-                          embed={"embedding": emb.dequantize(jnp.float32)})
+        params = dequant_embed(params)
         total = cache_len or (prompt_len + max_new_tokens)
         if prompt_len + max_new_tokens > total:
             raise ValueError(

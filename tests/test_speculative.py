@@ -1,0 +1,75 @@
+"""Speculative decoding: the one invariant that matters is bit-identity
+with the target model's own greedy decoding — for ANY draft model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.models.decode import generate
+from distkeras_tpu.models.speculative import make_speculative_generate_fn
+from distkeras_tpu.models.transformer import small_lm_spec
+
+
+def _spec(layers=2, dim=32, **kw):
+    cfg = dict(vocab_size=47, model_dim=dim, num_heads=2, num_layers=layers,
+               max_seq_len=64)
+    cfg.update(kw)
+    spec = small_lm_spec(**cfg)
+    spec.config["compute_dtype"] = "float32"
+    return spec
+
+
+@pytest.fixture(scope="module")
+def target():
+    return Model.init(_spec(layers=3, dim=48, num_heads=4), seed=0)
+
+
+def test_matches_target_greedy_with_good_draft(target):
+    """Draft = the target itself: every proposal accepted, output equal."""
+    prompt = jnp.asarray([[5, 17, 3, 9]], jnp.int32)
+    want = generate(target, prompt, max_new_tokens=12)
+    fn = make_speculative_generate_fn(target.spec, target.spec, 12, k=4)
+    got = fn(target.params, target.params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_matches_target_greedy_with_unrelated_draft(target):
+    """Draft = a differently-seeded small model: proposals mostly rejected,
+    output STILL equal (correctness never depends on draft quality)."""
+    draft = Model.init(_spec(layers=1, dim=32), seed=99)
+    prompt = jnp.asarray([[40, 2, 21]], jnp.int32)
+    want = generate(target, prompt, max_new_tokens=10)
+    for k in (1, 3, 5):
+        fn = make_speculative_generate_fn(target.spec, draft.spec, 10, k=k)
+        got = fn(target.params, draft.params, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"k={k}")
+
+
+def test_quantized_draft_still_exact(target):
+    """int8 draft params: schedule changes, tokens don't."""
+    from distkeras_tpu.ops.quantize import quantize_params
+
+    draft = Model.init(_spec(layers=1, dim=32), seed=7)
+    qd = quantize_params(draft.params, min_size=64)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want = generate(target, prompt, max_new_tokens=8)
+    fn = make_speculative_generate_fn(target.spec, draft.spec, 8, k=3)
+    got = fn(target.params, qd, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_guards(target):
+    draft = _spec(layers=1)
+    with pytest.raises(ValueError, match="vocab mismatch"):
+        make_speculative_generate_fn(target.spec, _spec(vocab_size=13), 8)
+    with pytest.raises(ValueError, match="k must be"):
+        make_speculative_generate_fn(target.spec, draft, 8, k=0)
+    fn = make_speculative_generate_fn(target.spec, draft, 8, k=2)
+    with pytest.raises(ValueError, match="batch-1"):
+        fn(target.params, Model.init(draft, seed=1).params,
+           jnp.zeros((2, 4), jnp.int32))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        fn(target.params, Model.init(draft, seed=1).params,
+           jnp.zeros((1, 60), jnp.int32))
