@@ -85,6 +85,42 @@ class ModelSpec:
 
         return apply
 
+    @property
+    def needs_rng(self) -> bool:
+        """True when training this architecture needs a PRNG key per step
+        (currently: sequential stacks containing active dropout layers).
+        Drives the trainers' key plumbing; paths without it must refuse
+        such specs (``reject_rng_spec``) rather than silently train with
+        dropout off."""
+        if self.name != "sequential":
+            return False
+        return any(l.get("kind") == "dropout" and float(l.get("rate", 0)) > 0
+                   for l in self.config.get("layers", ()))
+
+    def reject_rng_spec(self, where: str) -> None:
+        if self.needs_rng:
+            raise ValueError(
+                f"{where} has no PRNG plumbing (v1) and would silently train "
+                "with dropout disabled; remove the dropout layers or use "
+                "SingleTrainer / the sync distributed trainer family")
+
+    def train_apply_fn(self) -> Callable[[Any, jnp.ndarray, Any], jnp.ndarray]:
+        """Training-mode forward ``(params, x, rng) -> out``.
+
+        For specs with ``needs_rng`` the key feeds the dropout rng stream
+        and ``train=True`` activates the stochastic layers; otherwise the
+        rng is ignored and this is exactly ``apply_fn``."""
+        if not self.needs_rng:
+            plain = self.apply_fn()
+            return lambda params, x, rng: plain(params, x)
+        module = self.build()
+
+        def apply(params: Any, x: jnp.ndarray, rng) -> jnp.ndarray:
+            return module.apply({"params": params}, x, train=True,
+                                rngs={"dropout": rng})
+
+        return apply
+
     def reject_silent_aux(self, where: str) -> None:
         """Raise if training this spec through a plain ``apply_fn`` step
         would silently drop sown aux losses (``sow`` into an immutable

@@ -20,10 +20,12 @@ Layer kinds (constructor sugar below builds the dicts):
 - ``activation(name)`` — relu | gelu | tanh | sigmoid | softmax | elu |
   leaky_relu
 - ``layer_norm()``
-- ``dropout(rate)`` — **inert in v1**: the framework's compiled training
-  step is deterministic (no rng plumbed through ``apply_fn``); the layer
-  is accepted for architecture parity and applies identity.  A loud
-  ``UserWarning`` at build time says so.
+- ``dropout(rate)`` — real inverted dropout during training: trainers
+  whose step plumbs a PRNG key (``SingleTrainer`` and the sync
+  distributed family — ``ModelSpec.needs_rng`` drives the plumbing) pass
+  ``train=True`` + an rng; inference and ``Model.apply`` stay
+  deterministic.  Paths without rng plumbing (ZeRO/async, v1) refuse
+  dropout specs loudly instead of silently skipping regularization.
 - ``embed(vocab_size, dim)`` — int tokens [B, T] -> [B, T, dim]
 
 BatchNorm is deliberately absent: it needs mutable ``batch_stats``
@@ -33,7 +35,6 @@ an explicit error points there.
 
 from __future__ import annotations
 
-import warnings
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
@@ -85,7 +86,7 @@ class Sequential(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         if not self.layers:
             raise ValueError("sequential model needs at least one layer")
         for i, layer in enumerate(self.layers):
@@ -96,11 +97,6 @@ class Sequential(nn.Module):
                     raise ValueError(
                         f"layer {i}: unknown key(s) {sorted(extra)} for kind "
                         f"{kind!r}; allowed: {sorted(_ALLOWED_KEYS[kind])}")
-            if kind == "dropout":
-                warnings.warn(
-                    "dropout layers are inert in v1 (the compiled training "
-                    "step is deterministic); remove them or expect identity "
-                    "behavior", UserWarning, stacklevel=2)
             if kind == "dense":
                 x = nn.Dense(int(layer["units"]), dtype=self.compute_dtype,
                              name=f"dense_{i}")(x)
@@ -124,7 +120,7 @@ class Sequential(nn.Module):
             elif kind == "layer_norm":
                 x = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_{i}")(x)
             elif kind == "dropout":
-                pass  # inert (see module docstring); warned above
+                x = nn.Dropout(float(layer["rate"]))(x, deterministic=not train)
             elif kind == "embed":
                 x = nn.Embed(int(layer["vocab_size"]), int(layer["dim"]),
                              dtype=self.compute_dtype, name=f"embed_{i}")(x)

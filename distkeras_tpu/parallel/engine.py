@@ -53,21 +53,26 @@ def _ensure_varying(x, axis_name: str):
     return lax.pcast(x, (axis_name,), to="varying")
 
 
-def make_loss_fn(apply_fn: Callable, loss: Callable) -> Callable:
-    def loss_of(params, batch_x, batch_y):
-        return loss(apply_fn(params, batch_x), batch_y)
-
-    return loss_of
-
-
 def make_minibatch_step(apply_fn: Callable, loss: Callable,
-                        optimizer: optax.GradientTransformation) -> Callable:
-    """One ``train_on_batch`` equivalent: value_and_grad + optax update."""
-    loss_of = make_loss_fn(apply_fn, loss)
+                        optimizer: optax.GradientTransformation,
+                        with_rng: bool = False) -> Callable:
+    """One ``train_on_batch`` equivalent: value_and_grad + optax update.
+
+    ``with_rng=True``: ``apply_fn`` is a train-mode forward taking a PRNG
+    key (``ModelSpec.train_apply_fn``) and each scanned batch is
+    ``(x, y, key)`` — the key rides the batch stream, NOT the carry, so
+    state layouts (and checkpoint formats) are identical either way.
+    """
+    if with_rng:
+        def loss_of(params, batch):
+            return loss(apply_fn(params, batch[0], batch[2]), batch[1])
+    else:
+        def loss_of(params, batch):
+            return loss(apply_fn(params, batch[0]), batch[1])
 
     def step(carry, batch):
         params, opt_state = carry
-        loss_val, grads = jax.value_and_grad(loss_of)(params, batch[0], batch[1])
+        loss_val, grads = jax.value_and_grad(loss_of)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss_val
@@ -76,18 +81,27 @@ def make_minibatch_step(apply_fn: Callable, loss: Callable,
 
 
 def scan_epoch_fn(apply_fn: Callable, loss: Callable,
-                  optimizer: optax.GradientTransformation) -> Callable:
+                  optimizer: optax.GradientTransformation,
+                  with_rng: bool = False) -> Callable:
     """Single-device compiled epoch: lax.scan over [num_batches, bs, ...].
 
     Backs ``SingleTrainer`` — the reference's minimal path (SURVEY §3.2)
     with the per-row partition iterator replaced by one device transfer
-    and one XLA program per epoch.
+    and one XLA program per epoch.  ``with_rng``: see
+    :func:`make_minibatch_step`; the epoch then takes per-batch keys
+    [num_batches, 2] as a fourth array.
     """
-    mini = make_minibatch_step(apply_fn, loss, optimizer)
+    mini = make_minibatch_step(apply_fn, loss, optimizer, with_rng=with_rng)
 
-    def epoch(params, opt_state, xs, ys):
-        (params, opt_state), losses = lax.scan(mini, (params, opt_state), (xs, ys))
-        return params, opt_state, losses
+    if with_rng:
+        def epoch(params, opt_state, xs, ys, keys):
+            (params, opt_state), losses = lax.scan(
+                mini, (params, opt_state), (xs, ys, keys))
+            return params, opt_state, losses
+    else:
+        def epoch(params, opt_state, xs, ys):
+            (params, opt_state), losses = lax.scan(mini, (params, opt_state), (xs, ys))
+            return params, opt_state, losses
 
     return jax.jit(epoch, donate_argnums=(0, 1))
 
@@ -108,7 +122,11 @@ class WindowEngine:
         self.axis_name = axis_name
         self.window = int(window)
         self.num_replicas = mesh.shape[axis_name]
-        self._apply = spec.apply_fn()
+        # dropout-bearing specs train through the rng-taking forward; the
+        # per-batch keys ride the scanned data stream (state layout — and
+        # therefore checkpoints — identical either way)
+        self.needs_rng = spec.needs_rng
+        self._apply = spec.train_apply_fn() if self.needs_rng else spec.apply_fn()
         self._epoch_fn = self._build_epoch_fn()
 
     # -- state ----------------------------------------------------------------
@@ -163,9 +181,11 @@ class WindowEngine:
     def _build_epoch_fn(self) -> Callable:
         algo = self.algorithm
         axis = self.axis_name
-        mini = make_minibatch_step(self._apply, self.loss, self.optimizer)
+        needs_rng = self.needs_rng
+        mini = make_minibatch_step(self._apply, self.loss, self.optimizer,
+                                   with_rng=needs_rng)
 
-        def shard_fn(state: ReplicaState, xs, ys):
+        def shard_fn(state: ReplicaState, xs, ys, keys):
             # per-shard views: strip the leading (sharded) replica axis
             local = jax.tree.map(lambda a: a[0], state.local)
             opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
@@ -174,8 +194,17 @@ class WindowEngine:
 
             def window_step(carry, window_batches):
                 center, local, opt_state, extra = carry
-                wx, wy = window_batches
-                (local, opt_state), losses = lax.scan(mini, (local, opt_state), (wx, wy))
+                if needs_rng:
+                    wx, wy, wk = window_batches
+                    # same base key per batch everywhere, diverged per
+                    # replica so the masks differ across workers
+                    ridx = lax.axis_index(axis)
+                    wk = jax.vmap(lambda kk: jax.random.fold_in(kk, ridx))(wk)
+                    batches = (wx, wy, wk)
+                else:
+                    wx, wy = window_batches
+                    batches = (wx, wy)
+                (local, opt_state), losses = lax.scan(mini, (local, opt_state), batches)
                 center, local, extra = algo.window_commit(center, local, extra, axis)
                 # commit rules that reset local to the (mesh-invariant) center
                 # change the carry's varying-axes type; cast it back
@@ -185,7 +214,8 @@ class WindowEngine:
                 return (center, local, opt_state, extra), mean_loss
 
             (center, local, opt_state, extra), window_losses = lax.scan(
-                window_step, (center, local, opt_state, extra), (xs, ys)
+                window_step, (center, local, opt_state, extra),
+                (xs, ys, keys) if needs_rng else (xs, ys)
             )
             num_steps = xs.shape[0] * xs.shape[1]
             new_state = ReplicaState(
@@ -202,7 +232,7 @@ class WindowEngine:
         sharded = jax.shard_map(
             shard_fn,
             mesh=self.mesh,
-            in_specs=(specs, data_spec, data_spec),
+            in_specs=(specs, data_spec, data_spec, P()),  # keys replicated
             out_specs=(specs, P()),
         )
         return jax.jit(sharded, donate_argnums=(0,))
@@ -210,15 +240,25 @@ class WindowEngine:
     def data_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(None, None, self.axis_name))
 
-    def run_epoch(self, state: ReplicaState, xs: np.ndarray, ys: np.ndarray):
-        """xs/ys: [num_windows, window, global_batch, ...] host arrays.
+    def run_epoch(self, state: ReplicaState, xs: np.ndarray, ys: np.ndarray,
+                  keys: Optional[np.ndarray] = None):
+        """xs/ys: [num_windows, window, global_batch, ...] host arrays;
+        ``keys`` [num_windows, window, 2] uint32 per-batch dropout keys
+        (required iff the spec ``needs_rng``).
 
         Returns (new_state, per-window mean losses as numpy).
         """
         sharding = self.data_sharding()
         xs_d = jax.device_put(xs, sharding)
         ys_d = jax.device_put(ys, sharding)
-        state, losses = self._epoch_fn(state, xs_d, ys_d)
+        if keys is None:
+            # any constant is a valid (unused) threefry key when the spec
+            # has no rng need; a real run with needs_rng must pass keys
+            if self.needs_rng:
+                raise ValueError("this engine's spec needs per-batch dropout "
+                                 "keys; pass keys=[num_windows, window, 2]")
+            keys = np.zeros(xs.shape[:2] + (2,), np.uint32)
+        state, losses = self._epoch_fn(state, xs_d, ys_d, jnp.asarray(keys))
         return state, np.asarray(losses)
 
     # -- results ---------------------------------------------------------------

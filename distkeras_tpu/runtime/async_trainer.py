@@ -154,6 +154,7 @@ class AsyncDistributedTrainer(Trainer):
     # -- training --------------------------------------------------------------
     def train(self, dataset: Dataset, shuffle: bool = True, checkpointer=None,
               validation_data: Optional[Dataset] = None) -> Model:
+        self.model.spec.reject_rng_spec(type(self).__name__ + ".train")
         if validation_data is not None:
             raise ValueError(
                 "per-epoch validation is not supported for async trainers "

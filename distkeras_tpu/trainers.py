@@ -180,6 +180,14 @@ class Trainer:
             result["val_accuracy"] = correct / denom
         return result
 
+    def _batch_keys(self, epoch: int, chunk_idx: int, shape) -> np.ndarray:
+        """Deterministic per-(seed, epoch, chunk, batch) dropout keys —
+        raw uint32 threefry pairs, one per minibatch slot in ``shape``.
+        One definition for single and distributed trainers so the
+        determinism contract can't silently diverge between them."""
+        krng = np.random.default_rng([self.seed, epoch, chunk_idx])
+        return krng.integers(0, 2**32, size=tuple(shape) + (2,), dtype=np.uint32)
+
     def _record_epoch_metrics(self, epoch: int, samples: int, seconds: float,
                               chips: int = 1) -> None:
         """``chips`` = devices this trainer actually engaged — NOT
@@ -214,8 +222,12 @@ class SingleTrainer(Trainer):
         # on every call (callers like the baseline runner call train() once
         # per epoch to evaluate in between)
         epoch_fn = getattr(self, "_epoch_fn", None)
+        needs_rng = self.model.spec.needs_rng
         if epoch_fn is None:
-            epoch_fn = scan_epoch_fn(self.model.spec.apply_fn(), self.loss, self.optimizer)
+            apply = (self.model.spec.train_apply_fn() if needs_rng
+                     else self.model.spec.apply_fn())
+            epoch_fn = scan_epoch_fn(apply, self.loss, self.optimizer,
+                                     with_rng=needs_rng)
             self._epoch_fn = epoch_fn
         # epoch_fn donates its (params, opt_state) buffers; work on a copy so
         # the caller's Model object stays valid
@@ -237,15 +249,23 @@ class SingleTrainer(Trainer):
                 t_epoch = time.time()
                 samples = 0
                 ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+                chunk_idx = 0
                 for chunk in ds.chunked_epoch(self.batch_size,
                                               [self.features_col, self.label_col],
                                               window=1, chunk_windows=self.chunk_windows):
                     xs = chunk[self.features_col].squeeze(1)  # [num_batches, bs, ...]
                     ys = chunk[self.label_col].squeeze(1)
-                    params, opt_state, losses = epoch_fn(params, opt_state,
-                                                         jnp.asarray(xs), jnp.asarray(ys))
+                    if needs_rng:
+                        keys = self._batch_keys(epoch, chunk_idx, (xs.shape[0],))
+                        params, opt_state, losses = epoch_fn(
+                            params, opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                            jnp.asarray(keys))
+                    else:
+                        params, opt_state, losses = epoch_fn(params, opt_state,
+                                                             jnp.asarray(xs), jnp.asarray(ys))
                     self.history.extend(np.asarray(losses).tolist())
                     samples += xs.shape[0] * xs.shape[1]
+                    chunk_idx += 1
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch, chips=1)
                 val = self._validate(params, validation_data)
                 if val:
@@ -318,15 +338,21 @@ class DistributedTrainer(Trainer):
                 t_epoch = time.time()
                 samples = 0
                 ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
+                chunk_idx = 0
                 for chunk in ds.chunked_epoch(global_batch,
                                               [self.features_col, self.label_col],
                                               window=self.communication_window,
                                               chunk_windows=self.chunk_windows):
+                    keys = None
+                    if engine.needs_rng:
+                        keys = self._batch_keys(
+                            epoch, chunk_idx, chunk[self.features_col].shape[:2])
                     state, losses = engine.run_epoch(state, chunk[self.features_col],
-                                                     chunk[self.label_col])
+                                                     chunk[self.label_col], keys=keys)
                     self.history.extend(losses.tolist())
                     samples += (chunk[self.features_col].shape[0]
                                 * self.communication_window * global_batch)
+                    chunk_idx += 1
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
                                            chips=self.num_workers)
                 if validation_data is not None:

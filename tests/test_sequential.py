@@ -69,13 +69,27 @@ def test_activation_and_kind_errors_name_the_layer():
         Model.init(sequential_spec([], input_shape=(3,)), seed=0)
 
 
-def test_dropout_warns_and_is_inert():
-    spec = sequential_spec([dense(4, "relu"), dropout(0.5), dense(2)],
+def test_dropout_inference_deterministic_training_stochastic():
+    import jax
+
+    spec = sequential_spec([dense(32, "relu"), dropout(0.5), dense(2)],
                            input_shape=(3,))
-    with pytest.warns(UserWarning, match="inert"):
-        m = Model.init(spec, seed=0)
+    m = Model.init(spec, seed=0)
     x = jnp.ones((2, 3))
+    # inference path: dropout off, bit-reproducible
     np.testing.assert_array_equal(np.asarray(m.apply(x)), np.asarray(m.apply(x)))
+    # train path: two keys -> two masks -> different outputs
+    train_apply = spec.train_apply_fn()
+    a = np.asarray(train_apply(m.params, x, jax.random.PRNGKey(0)))
+    b = np.asarray(train_apply(m.params, x, jax.random.PRNGKey(1)))
+    assert np.abs(a - b).max() > 0
+    # same key -> same mask
+    np.testing.assert_array_equal(
+        a, np.asarray(train_apply(m.params, x, jax.random.PRNGKey(0))))
+    assert spec.needs_rng
+    assert not sequential_spec([dense(4)], input_shape=(3,)).needs_rng
+    assert not sequential_spec([dense(4), dropout(0.0)],
+                               input_shape=(3,)).needs_rng
 
 
 def test_typoed_layer_keys_fail_loudly():
