@@ -53,6 +53,8 @@ _EXPORTS = {
     "start_parameter_server": "distkeras_tpu.runtime.launcher",
     "Checkpointer": "distkeras_tpu.checkpoint",
     "Dataset": "distkeras_tpu.data.dataset",
+    "Tokenizer": "distkeras_tpu.data.text",
+    "pad_sequences": "distkeras_tpu.data.text",
     "ColumnFile": "distkeras_tpu.data.colfile",
     "write_columns": "distkeras_tpu.data.colfile",
     "Model": "distkeras_tpu.models.base",

@@ -1,0 +1,115 @@
+"""Text preprocessing (Tokenizer / pad_sequences) and the text -> LSTM
+pipeline end-to-end."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.text import Tokenizer, pad_sequences
+
+
+def test_tokenizer_basic_ranking_and_reserved_zero():
+    tok = Tokenizer().fit_on_texts(["the cat sat", "the cat ran", "the dog"])
+    # 'the' most frequent -> index 1 (0 reserved for padding)
+    assert tok.word_index["the"] == 1
+    assert tok.word_index["cat"] == 2
+    seqs = tok.texts_to_sequences(["the cat", "dog the"])
+    assert seqs[0] == [1, 2]
+    assert 0 not in {i for s in seqs for i in s}
+    assert tok.vocab_size == max(tok.word_index.values()) + 1
+
+
+def test_tokenizer_filters_lower_and_oov():
+    tok = Tokenizer(oov_token="<oov>").fit_on_texts(["Hello, World! hello?"])
+    assert tok.word_index["<oov>"] == 1
+    assert tok.word_index["hello"] == 2  # case-folded, punctuation stripped
+    assert tok.texts_to_sequences(["hello UNSEEN world"])[0] == [2, 1, 3]
+    # without oov, unseen words drop
+    tok2 = Tokenizer().fit_on_texts(["a b"])
+    assert tok2.texts_to_sequences(["a zzz b"])[0] == [1, 2] or \
+        tok2.texts_to_sequences(["a zzz b"])[0] == [2, 1]
+
+
+def test_num_words_caps_encoding():
+    texts = ["a a a b b c"]
+    tok = Tokenizer(num_words=3).fit_on_texts(texts)
+    # vocab capped at indices < 3: 'a'->1, 'b'->2 survive, 'c'->3 dropped
+    assert tok.texts_to_sequences(texts)[0] == [1, 1, 1, 2, 2]
+    assert tok.vocab_size == 3
+
+
+def test_tokenizer_json_roundtrip():
+    tok = Tokenizer(num_words=10, oov_token="<oov>").fit_on_texts(
+        ["one two two three three three"])
+    tok2 = Tokenizer.from_json(tok.to_json())
+    assert tok2.word_index == tok.word_index
+    texts = ["three unseen one"]
+    assert tok2.texts_to_sequences(texts) == tok.texts_to_sequences(texts)
+
+
+def test_filters_are_literal_characters_not_regex():
+    # '*-+' as a regex class is a bad range; as literal chars it's fine
+    tok = Tokenizer(filters="*-+").fit_on_texts(["a*b-c+d e"])
+    assert set(tok.word_index) == {"a", "b", "c", "d", "e"}
+
+
+def test_oov_token_in_corpus_keeps_index_one():
+    tok = Tokenizer(oov_token="unk").fit_on_texts(["unk unk unk word"])
+    assert tok.word_index["unk"] == 1
+    # a word NEVER ranks into index 1
+    assert sorted(tok.word_index.values()) == sorted(set(tok.word_index.values()))
+    assert tok.texts_to_sequences(["unseen"])[0] == [1]
+
+
+def test_empty_corpus_oov_roundtrip():
+    tok = Tokenizer(oov_token="<oov>").fit_on_texts([])
+    t2 = Tokenizer.from_json(tok.to_json())
+    assert t2.texts_to_sequences(["anything"]) == tok.texts_to_sequences(["anything"]) == [[1]]
+
+
+def test_pad_sequences_maxlen_zero():
+    assert pad_sequences([[1, 2]], maxlen=0).shape == (1, 0)
+
+
+def test_pad_sequences_semantics():
+    seqs = [[1, 2, 3], [4], []]
+    np.testing.assert_array_equal(
+        pad_sequences(seqs, maxlen=4),
+        [[0, 1, 2, 3], [0, 0, 0, 4], [0, 0, 0, 0]])
+    np.testing.assert_array_equal(
+        pad_sequences(seqs, maxlen=2, padding="post", truncating="post"),
+        [[1, 2], [4, 0], [0, 0]])
+    # pre-truncation keeps the TAIL
+    np.testing.assert_array_equal(pad_sequences([[1, 2, 3, 4]], maxlen=2),
+                                  [[3, 4]])
+    assert pad_sequences([], maxlen=3).shape == (0, 3)
+    with pytest.raises(ValueError, match="pre.*post|'pre' or 'post'"):
+        pad_sequences(seqs, padding="left")
+
+
+def test_text_to_lstm_pipeline_learns():
+    """Raw text -> Tokenizer -> pad_sequences -> Dataset -> LSTM trainer:
+    the full Keras-era sentiment-style pipeline, on a separable toy task
+    (class = whether 'good' or 'bad' appears)."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.rnn import lstm_classifier_spec
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(0)
+    fillers = ["movie", "film", "plot", "acting", "scene", "it", "was", "very"]
+    texts, labels = [], []
+    for _ in range(256):
+        words = list(rng.choice(fillers, size=6))
+        lab = int(rng.integers(0, 2))
+        words.insert(int(rng.integers(0, len(words))), "good" if lab else "bad")
+        texts.append(" ".join(words))
+        labels.append(lab)
+    tok = Tokenizer().fit_on_texts(texts)
+    x = pad_sequences(tok.texts_to_sequences(texts), maxlen=8)
+    y = np.eye(2, dtype=np.float32)[labels]
+    spec = lstm_classifier_spec(vocab_size=tok.vocab_size, seq_len=8,
+                                embed_dim=16, hidden_sizes=(32,), num_outputs=2)
+    tr = SingleTrainer(spec, worker_optimizer="adam", learning_rate=3e-3,
+                       batch_size=32, num_epoch=12, seed=1)
+    model = tr.train(Dataset({"features": x, "label": y}))
+    pred = np.argmax(model.predict(x), axis=1)
+    assert (pred == np.asarray(labels)).mean() > 0.95
