@@ -180,6 +180,59 @@ class Trainer:
             result["val_accuracy"] = correct / denom
         return result
 
+    class _EarlyStopping:
+        """Keras-``EarlyStopping`` semantics over the per-epoch validation
+        metrics: stop after ``patience`` epochs without ``min_delta``
+        improvement on ``monitor`` (val_loss: lower is better;
+        val_accuracy: higher).  ``restore_best=True`` (default) hands the
+        best-epoch weights back instead of the last ones."""
+
+        def __init__(self, patience: int = 3, min_delta: float = 0.0,
+                     monitor: str = "val_loss", restore_best: bool = True):
+            if monitor not in ("val_loss", "val_accuracy"):
+                raise ValueError(f"monitor must be val_loss or val_accuracy, "
+                                 f"got {monitor!r}")
+            self.patience = int(patience)
+            self.min_delta = float(min_delta)
+            self.monitor = monitor
+            self.restore_best = bool(restore_best)
+            self.best: Optional[float] = None
+            self.best_params = None
+            self.stale = 0
+            self.stopped_epoch: Optional[int] = None
+
+        def update(self, epoch: int, metrics: dict, params) -> bool:
+            """Record this epoch; True = stop now."""
+            if self.monitor not in metrics:
+                raise ValueError(
+                    f"early stopping monitors {self.monitor!r} but the epoch "
+                    f"metrics lack it (keys: {sorted(metrics)}); pass "
+                    "validation_data=")
+            value = metrics[self.monitor]
+            better = (self.best is None
+                      or (value < self.best - self.min_delta
+                          if self.monitor == "val_loss"
+                          else value > self.best + self.min_delta))
+            if better:
+                self.best = value
+                self.stale = 0
+                if self.restore_best:
+                    self.best_params = jax.tree.map(np.asarray, params)
+            else:
+                self.stale += 1
+                if self.stale > self.patience:
+                    self.stopped_epoch = epoch
+                    return True
+            return False
+
+    @staticmethod
+    def _early_stopper(early_stopping) -> Optional["Trainer._EarlyStopping"]:
+        if early_stopping is None:
+            return None
+        if isinstance(early_stopping, Trainer._EarlyStopping):
+            return early_stopping
+        return Trainer._EarlyStopping(**dict(early_stopping))
+
     def _batch_keys(self, epoch: int, chunk_idx: int, shape) -> np.ndarray:
         """Deterministic per-(seed, epoch, chunk, batch) dropout keys —
         raw uint32 threefry pairs, one per minibatch slot in ``shape``.
