@@ -147,8 +147,15 @@ def _bench_mnist_cnn(batch_size: int = _MNIST_BATCH, num_batches: int = 200, rep
 
 
 def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int = 8,
-              num_layers: int = 8, vocab: int = 8192, steps: int = 10):
+              num_layers: int = 8, vocab: int = 8192, steps: int = 10,
+              remat: bool = False):
     """TransformerLM fwd+bwd train step: tokens/sec + MFU (flash attention).
+
+    The loss path is the framework's fused unembed+CE
+    (``ops.losses.unembed_cross_entropy``, same as ``make_lm_train_step``):
+    the unembed matmul runs in bf16 at MXU rate and the [B, L, V] f32
+    logits tensor is never materialized — on v5e this moved the 2k-token
+    step from 0.28 to ~0.4 MFU by itself (round-3 sweep).
 
     MFU counts the matmul FLOPs the model *requires*: 6·T·P_matmul for the
     dense projections + unembed (fwd 2·T·P, bwd 2x) plus the causal
@@ -162,18 +169,17 @@ def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int 
 
     from distkeras_tpu.models.base import Model
     from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.ops.losses import lm_token_cross_entropy
     from distkeras_tpu.parallel.lm import shift_targets
 
     spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim, num_heads=num_heads,
-                         num_layers=num_layers, max_seq_len=seq_len)
+                         num_layers=num_layers, max_seq_len=seq_len, remat=remat)
     model = Model.init(spec, seed=0)
-    apply_fn = spec.apply_fn()
+    module = spec.build()
     opt = optax.sgd(0.01)
 
     def loss_fn(params, tok, tgt):
-        logits = apply_fn(params, tok)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), tgt)
+        ce = lm_token_cross_entropy(module, params, tok, tgt)
         return ce[:, :-1].mean()
 
     # the step loop lives INSIDE the compiled program: per-dispatch host

@@ -180,13 +180,28 @@ class TransformerLM(nn.Module):
         x = self.final_norm(x)
         return self.embed.attend(x.astype(jnp.float32))
 
-    def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+    def _trunk(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        """Embedding + blocks, BEFORE the final norm: [B, L] -> [B, L, E]."""
         x = self.embed_tokens(tokens, pos_offset)
         run = (nn.remat(lambda m, y: m(y), prevent_cse=False)
                if self.remat else (lambda m, y: m(y)))
         for blk in self.block:
             x = run(blk, x)
-        return self.head(x)
+        return x
+
+    def hidden(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        """Forward WITHOUT the unembed: [B, L] -> final-normed [B, L, E].
+
+        Train-loss entry point: pair with ``ops.losses.unembed_cross_entropy``
+        (against ``params['embed']['embedding']``) so the [B, L, vocab]
+        float32 logits tensor is computed chunkwise in bfloat16 instead of
+        materialized by ``head``'s float32 ``attend`` — kills the
+        half-rate f32 unembed matmul and O(B*L*V) activation memory.
+        """
+        return self.final_norm(self._trunk(tokens, pos_offset))
+
+    def __call__(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
+        return self.head(self._trunk(tokens, pos_offset))
 
 
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,

@@ -53,6 +53,16 @@ _NEG_INF = float("-inf")
 _STAT_LANES = 8  # trailing lanes for per-row stats (min f32 tile lane count
                  # that can equal the array dim; avoids 128x padding waste)
 
+# Mosaic's default scoped-vmem budget is 16M, which the dkv kernel's working
+# set at (1024, 1024) blocks overflows by 8K inside full transformer backward
+# programs (round-2 block sweep).  24M is the measured sweet spot (v5e,
+# 2026-07-30 profiled device-time A/B): enough for the large-block dkv pass,
+# while a generous 96M grant made the same kernels ~4-5% SLOWER at 2k/8k —
+# Mosaic folds the budget into its pipelining decisions, so grant the
+# minimum that fits.
+_VMEM_LIMIT = 24 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
 
 class _Config(NamedTuple):
     """Static kernel configuration (hashable: custom_vjp nondiff argument).
@@ -60,9 +70,10 @@ class _Config(NamedTuple):
     Three block pairs: forward, dq, and dkv.  The dq kernel streams kv
     blocks like the forward and by default shares its blocks; the dkv
     kernel carries the largest VMEM working set (two outputs + two f32
-    scratch accumulators) and needs smaller defaults — (1024, 1024) dkv
-    lands 8K over the 16M scoped-vmem limit inside full transformer
-    backward programs where the same blocks compile fine for fwd/dq."""
+    scratch accumulators) and historically needed smaller blocks — its
+    (1024, 1024) working set lands 8K over Mosaic's 16M default scoped-vmem
+    budget — but with the module's raised ``_VMEM_LIMIT`` grant all three
+    kernels share the forward blocks by default."""
 
     causal: bool
     q_offset: int
@@ -251,6 +262,7 @@ def _forward(q, k, v, cfg: _Config):
             pltpu.VMEM((bq, d), jnp.float32),            # output accumulator
         ],
         interpret=cfg.interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(q, k, v)
 
 
@@ -280,6 +292,7 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
         out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=cfg.interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -306,6 +319,7 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
             pltpu.VMEM((bk_kv, d), jnp.float32),
         ],
         interpret=cfg.interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -347,13 +361,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     Three kernels, three block pairs.  Defaults (v5e sweeps, 2026-07-30):
     the forward auto-selects ``block_q`` 1024 at >= 16k tokens and 512
-    below; the dq pass shares the forward blocks (same kv-streaming shape,
-    one scratch — measured to compile at (1024, 1024) inside full 32k LM
-    backward programs, together worth ~7% on the 32k train step); the dkv
-    pass defaults to (512, ``block_k``) because its working set at
-    (1024, 1024) lands 8K over the 16M scoped-vmem limit inside full
-    transformer backward programs.  (512, 1024) is within ~7% of peak at
-    2k/8k; small blocks lose badly (128 runs at 0.4x dense).
+    below; BOTH backward passes share the forward blocks — the dkv working
+    set at (1024, 1024) needs the raised ``_VMEM_LIMIT`` scoped-vmem grant
+    (it overflows Mosaic's 16M default by 8K), measured worth ~2-7% at 32k
+    over the old (512, 1024) dkv fallback.  Small blocks lose badly
+    (128 runs at 0.4x dense).
 
     Explicit knobs override: ``block_q``/``block_k`` govern the forward
     AND (absent bwd overrides) both backward kernels, so one knob tunes
@@ -369,14 +381,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
-    explicit_fwd_q = block_q is not None
     if block_q is None:
         block_q = 1024 if lq >= 16384 else 512
     if block_q_bwd is None:
-        # dkv default: 512, or an explicitly-chosen forward block (the 16k
-        # auto-upgrade must NOT reach dkv — 1024 is its scoped-vmem overflow)
-        dkv_q = block_q if explicit_fwd_q else 512
-        dq_q = block_q  # dq tracks the forward, auto-upgrade included
+        # both backward kernels track the forward block, auto-upgrade
+        # included: the raised scoped-vmem grant (_VMEM_LIMIT) fits the
+        # (1024, 1024) dkv working set that overflowed the 16M default
+        dq_q = dkv_q = block_q
     else:
         dq_q = dkv_q = block_q_bwd
     if block_k_bwd is None:

@@ -13,12 +13,98 @@ distributed trainers to match the reference's per-batch semantics).
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def _pick_chunks(rows: int, target_rows: int) -> int:
+    """Chunk count with the largest chunk size that divides ``rows`` and
+    stays <= ``target_rows``.  Awkward factorizations (e.g. prime ``rows``,
+    where the only fitting divisor would mean near-per-row chunks and a
+    long sequential ``lax.map``) fall back to a single dense chunk —
+    materializing the logits once beats serializing thousands of tiny
+    matmuls."""
+    if rows <= target_rows:
+        return 1
+    for n in range(2, rows + 1):
+        if rows % n == 0 and rows // n <= target_rows:
+            if rows // n >= max(8, target_rows // 8):
+                return n
+            break  # divisors only get smaller from here
+    return 1
+
+
+def unembed_cross_entropy(hidden: jnp.ndarray, table: jnp.ndarray,
+                          targets: jnp.ndarray, chunk_rows: int = 2048,
+                          compute_dtype: Optional[jnp.dtype] = jnp.bfloat16) -> jnp.ndarray:
+    """Fused unembed + softmax CE that never materializes full logits.
+
+    ``hidden`` [B, L, E] (final-norm output), ``table`` [V, E] (the tied
+    embedding matrix), ``targets`` [B, L] int.  Returns per-position CE
+    [B, L] in float32.
+
+    Two wins over ``head() -> optax CE`` on TPU:
+
+    - the unembed matmul runs in ``compute_dtype`` (default bfloat16 — full
+      MXU rate) with float32 accumulation via ``preferred_element_type``,
+      instead of the float32 x float32 matmul ``embed.attend`` issues;
+    - the [B*L, V] float32 logits tensor is computed ``chunk_rows`` rows at
+      a time inside a ``lax.map`` whose body is ``jax.checkpoint``'d, so
+      the backward recomputes each chunk instead of keeping ~0.5 GB of
+      logits (+ another in the cotangent) live across the whole backward.
+      Peak logit memory drops from O(B*L*V) to O(chunk_rows * V).
+
+    ``compute_dtype=None`` keeps the inputs' dtype (exact-parity testing).
+    """
+    b, l, e = hidden.shape
+    rows = b * l
+    h2 = hidden.reshape(rows, e)
+    t2 = targets.reshape(rows).astype(jnp.int32)
+    if compute_dtype is not None:
+        h2 = h2.astype(compute_dtype)
+        table = table.astype(compute_dtype)
+
+    def chunk_ce(hc, tc):
+        logits = lax.dot_general(hc, table, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [rows_c, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return lse - tgt
+
+    n_chunks = _pick_chunks(rows, chunk_rows)
+    if n_chunks == 1:
+        ce = chunk_ce(h2, t2)
+    else:
+        body = jax.checkpoint(chunk_ce, prevent_cse=False)
+        ce = lax.map(lambda args: body(*args),
+                     (h2.reshape(n_chunks, rows // n_chunks, e),
+                      t2.reshape(n_chunks, rows // n_chunks)))
+    return ce.reshape(b, l)
+
+
+def lm_token_cross_entropy(module, params, tokens: jnp.ndarray, targets: jnp.ndarray,
+                           pos_offset=0, chunk_rows: int = 2048,
+                           compute_dtype: Optional[jnp.dtype] = jnp.bfloat16) -> jnp.ndarray:
+    """Per-position next-token CE [B, L] for a tied-embedding LM.
+
+    The single home of the fused-loss wiring contract: ``module`` must
+    expose a ``hidden`` method (forward up to and including the final norm,
+    no unembed) and keep its tied unembedding table at
+    ``params['embed']['embedding']`` — i.e. ``models.transformer
+    .TransformerLM``.  Used by ``parallel/lm.py``, the bench, and the
+    parity tests so the pairing lives in exactly one place.
+    """
+    h = module.apply({"params": params}, tokens, pos_offset=pos_offset,
+                     method="hidden")
+    return unembed_cross_entropy(h, params["embed"]["embedding"],
+                                 targets.astype(jnp.int32),
+                                 chunk_rows=chunk_rows, compute_dtype=compute_dtype)
 
 
 def categorical_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

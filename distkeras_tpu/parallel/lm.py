@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.models.base import ModelSpec, build_module
+from distkeras_tpu.ops.losses import lm_token_cross_entropy
 
 
 def _path_names(path) -> Tuple[str, ...]:
@@ -115,9 +116,12 @@ def make_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
     loss_axes = (dp_axis, sp_axis) if sp_active else (dp_axis,)
 
     def local_loss(params, tokens, targets, offset):
-        logits = module.apply({"params": params}, tokens, pos_offset=offset)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), targets.astype(jnp.int32))
+        # fused unembed+CE: the [B, L, V] f32 logits tensor is never
+        # materialized and the unembed matmul runs at bf16 MXU rate
+        # (ops/losses.py) — the embed table is replicated under tp, so the
+        # fused path is tp-invariant like head()
+        ce = lm_token_cross_entropy(module, params, tokens, targets,
+                                    pos_offset=offset)
         # mask the GLOBAL final position: its target is shift_targets'
         # padding, not a real next token.  Global position = offset + local
         # index; only the last sp shard holds the padded column.
