@@ -67,13 +67,11 @@ _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 class _Config(NamedTuple):
     """Static kernel configuration (hashable: custom_vjp nondiff argument).
 
-    Three block pairs: forward, dq, and dkv.  The dq kernel streams kv
-    blocks like the forward and by default shares its blocks; the dkv
-    kernel carries the largest VMEM working set (two outputs + two f32
-    scratch accumulators) and historically needed smaller blocks — its
-    (1024, 1024) working set lands 8K over Mosaic's 16M default scoped-vmem
-    budget — but with the module's raised ``_VMEM_LIMIT`` grant all three
-    kernels share the forward blocks by default."""
+    Three block pairs: forward, dq, and dkv.  The backward normally runs as
+    ONE fused kernel (``_bwd_fused_kernel``) using the dkv pair; the dq
+    pair only matters on the two-kernel fallback taken when the fused
+    kernel's [Lq, D] dq scratch would overflow scoped vmem
+    (``_fused_bwd_ok``)."""
 
     causal: bool
     q_offset: int
@@ -160,6 +158,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
 
 
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    cfg: _Config, scale: float, qi, kj, bq, bk):
+    """Shared backward recompute: (p, ds, refs' blocks) for one
+    [bq, bk] tile.  p = softmax probabilities rebuilt from the stored
+    logsumexp (masked entries exactly 0), ds = p * (dp - delta) in float32.
+    Used by all three backward kernels so the score/probability algebra
+    lives in one place."""
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
+    delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if cfg.causal:
+        s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
+    p = jnp.exp(s - lse)  # masked/-inf entries -> exactly 0
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    return p, ds, q, do, k_blk, v_blk
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, cfg: _Config, scale: float):
     qi, kj = pl.program_id(2), pl.program_id(3)
@@ -172,20 +194,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(_block_visible(cfg, qi, kj, bq, bk))
     def _compute():
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
-        delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
-        k_blk = k_ref[0, 0]
-        v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if cfg.causal:
-            s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
-        p = jnp.exp(s - lse)  # masked/-inf entries -> exactly 0
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        _, ds, _, _, k_blk, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, cfg, scale, qi, kj, bq, bk)
         dq_scr[...] += jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
                                            (((1,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -208,29 +218,88 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_block_visible(cfg, qi, kj, bq, bk))
     def _compute():
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
-        delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
-        k_blk = k_ref[0, 0]
-        v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if cfg.causal:
-            s = _apply_causal_mask(s, cfg, qi, kj, bq, bk)
-        p = jnp.exp(s - lse)
+        p, ds, q, do, _, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, cfg, scale, qi, kj, bq, bk)
         dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
-        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk_scr[...] += jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _flush():
         dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr, *,
+                      cfg: _Config, scale: float):
+    """One-pass backward: dK, dV and dQ from a single s/p recomputation.
+
+    The separate dq kernel re-derives the identical [bq, bk] score and
+    probability blocks the dkv kernel just computed — at small head dims
+    that recompute IS the kernel cost, so fusing the two backward passes
+    cuts backward time by ~the dq kernel (measured ~25-30% off the whole
+    fwd+bwd attention step on v5e).
+
+    The catch is accumulation order: dK/dV accumulate over the inner qi
+    steps (scratch flushed per kv block, as before) while dQ accumulates
+    over the OUTER kj steps.  A [Lq, D] float32 scratch holds every dq row
+    for the (b, h) pair; row block qi is updated in place via a dynamic
+    slice and the dq output block is flushed on the final kj pass.  The
+    scratch makes VMEM O(Lq * D) rather than O(block) — ``_backward``
+    falls back to the two-kernel path when that does not fit.
+    """
+    kj, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    nk = pl.num_programs(2)
+    bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when((kj == 0) & (qi == 0))
+    def _init_q():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_visible(cfg, qi, kj, bq, bk))
+    def _compute():
+        p, ds, q, do, k_blk, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, cfg, scale, qi, kj, bq, bk)
+        ds = ds.astype(q.dtype)
+        dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dq_scr[pl.ds(qi * bq, bq), :] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _flush_kv():
+        dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+    # dq row block qi receives its final contribution on the last kj pass;
+    # earlier passes emit stale blocks that the final, ordered revisit of
+    # the same HBM region overwrites
+    @pl.when(kj == nk - 1)
+    def _flush_q():
+        dq_ref[0, 0] = (dq_scr[pl.ds(qi * bq, bq), :] * scale).astype(dq_ref.dtype)
+
+
+def _out_struct(shape, dtype, *like):
+    """ShapeDtypeStruct whose ``vma`` (varying-mesh-axes set) is the union
+    of the inputs' — required for pallas_call outputs under ``shard_map``
+    with vma checking (e.g. the dp-sharded LM step); plain jit traces have
+    no vma and take the unannotated branch."""
+    vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+                              for x in like))
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _forward(q, k, v, cfg: _Config):
@@ -253,8 +322,8 @@ def _forward(q, k, v, cfg: _Config):
             pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, lq, _STAT_LANES), jnp.float32),
+            _out_struct((b, h, lq, d), q.dtype, q, k, v),
+            _out_struct((b, h, lq, _STAT_LANES), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running max
@@ -264,6 +333,85 @@ def _forward(q, k, v, cfg: _Config):
         interpret=cfg.interpret,
         compiler_params=_COMPILER_PARAMS,
     )(q, k, v)
+
+
+# Fused-backward eligibility (v5e scoped-vmem measurements, 2026-07-30).
+# The fused kernel's [Lq, D] float32 dq scratch plus its block working set
+# must fit the scoped-vmem budget; measured boundaries at D=64:
+#   (1024, 1024) blocks fit when BOTH the dq scratch and the streamed kv
+#     length stay small (through Lq=Lk=16k), and are 2-3% faster than
+#     (512, 1024) everywhere they fit; OOM when Lk reaches 32k;
+#   (512, 1024) blocks fit through Lq=16k (dq scratch 4.2M) at ANY Lk
+#     (the 32k leg runs them via q-chunking), OOM at unchunked Lq=32k;
+#   (512, 512) blocks fit through Lq=32k (dq scratch 8.4M);
+#   above that, fall back to the two-kernel backward with wide blocks.
+_FUSED_WIDE_CAP = 5 * 1024 * 1024       # dq / lk-stream cap for 1024-wide blocks
+_FUSED_DQ_SCRATCH_CAP = 12 * 1024 * 1024  # dq scratch cap for (<=512, <=512)
+
+
+def _fused_bwd_ok(lq: int, d: int, bq_kv: int, bk_kv: int, lk: int) -> bool:
+    dq_bytes = lq * d * 4
+    if bk_kv > 1024:
+        return False
+    if bq_kv > 1024:
+        return False
+    if bq_kv > 512:
+        return dq_bytes <= _FUSED_WIDE_CAP and lk * d * 4 <= _FUSED_WIDE_CAP
+    if bk_kv <= 512:
+        return dq_bytes <= _FUSED_DQ_SCRATCH_CAP
+    return dq_bytes <= _FUSED_WIDE_CAP
+
+
+def _fused_backward_call(q, k, v, do, lse, delta, cfg: _Config, scale: float):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq_kv, bk_kv = cfg.block_q_bwd, cfg.block_k_bwd
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, cfg=cfg, scale=scale),
+        grid=(b, h, lk // bk_kv, lq // bq_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq_kv, d), lambda b, h, j, i: (b, h, i, 0)),   # q
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # k
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # v
+            pl.BlockSpec((1, 1, bq_kv, d), lambda b, h, j, i: (b, h, i, 0)),   # do
+            pl.BlockSpec((1, 1, bq_kv, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # lse
+            pl.BlockSpec((1, 1, bq_kv, _STAT_LANES), lambda b, h, j, i: (b, h, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # dk
+            pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),   # dv
+            pl.BlockSpec((1, 1, bq_kv, d), lambda b, h, j, i: (b, h, i, 0)),   # dq
+        ],
+        out_shape=[
+            _out_struct((b, h, lk, d), k.dtype, q, k, v, do),
+            _out_struct((b, h, lk, d), v.dtype, q, k, v, do),
+            _out_struct((b, h, lq, d), q.dtype, q, k, v, do),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk_kv, d), jnp.float32),
+            pltpu.VMEM((bk_kv, d), jnp.float32),
+            pltpu.VMEM((lq, d), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(q, k, v, do, lse, delta)
+
+
+_FUSED_MAX_CHUNKS = 16
+
+
+def _fused_q_chunks(lq: int, d: int, bq_kv: int, bk_kv: int, lk: int):
+    """Number of equal q-range chunks that makes the fused backward's
+    [chunk, D] dq scratch fit scoped vmem (1 = single call, None = cannot
+    chunk: fall back to the two-kernel backward).  Chunks re-stream k/v, so
+    cap the count — beyond ~16 the repeated kv DMA erodes the win."""
+    for n in range(1, _FUSED_MAX_CHUNKS + 1):
+        if lq % n:
+            continue
+        chunk = lq // n
+        if chunk % bq_kv == 0 and _fused_bwd_ok(chunk, d, bq_kv, bk_kv, lk):
+            return n
+    return None
 
 
 def _backward(q, k, v, o, lse, do, cfg: _Config):
@@ -277,6 +425,30 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
     delta = jnp.einsum("bhld,bhld->bhl", do.astype(jnp.float32), o.astype(jnp.float32))
     delta = jnp.broadcast_to(delta[..., None], (b, h, lq, _STAT_LANES))
 
+    n_chunks = _fused_q_chunks(lq, d, bq_kv, bk_kv, lk)
+    if n_chunks == 1:
+        dk, dv, dq = _fused_backward_call(q, k, v, do, lse, delta, cfg, scale)
+        return dq, dk, dv
+    if n_chunks is not None:
+        # chunk the q range so each fused call's dq scratch fits scoped
+        # vmem: dq concatenates over chunks, dk/dv sum partial results
+        # (kv blocks invisible to a chunk flush zeros, so the sum is exact;
+        # each chunk's q_offset keeps the causal predication global)
+        chunk = lq // n_chunks
+        dk = dv = None
+        dqs = []
+        for c in range(n_chunks):
+            sl = lambda x: jax.lax.slice_in_dim(x, c * chunk, (c + 1) * chunk, axis=2)
+            cfg_c = cfg._replace(q_offset=cfg.q_offset + c * chunk)
+            dk_c, dv_c, dq_c = _fused_backward_call(
+                sl(q), k, v, sl(do), sl(lse), sl(delta), cfg_c, scale)
+            # accumulate partials in f32: summing bf16 chunk outputs would
+            # round at every add, a precision cliff vs the unchunked path
+            dk = dk_c.astype(jnp.float32) if dk is None else dk + dk_c
+            dv = dv_c.astype(jnp.float32) if dv is None else dv + dv_c
+            dqs.append(dq_c)
+        return (jnp.concatenate(dqs, axis=2), dk.astype(k.dtype), dv.astype(v.dtype))
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, cfg=cfg, scale=scale),
         grid=(b, h, lq // bq, lk // bk),
@@ -289,7 +461,7 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
             pl.BlockSpec((1, 1, bq, _STAT_LANES), lambda b, h, i, j: (b, h, i, 0)),  # delta
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        out_shape=_out_struct((b, h, lq, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=cfg.interpret,
         compiler_params=_COMPILER_PARAMS,
@@ -311,8 +483,8 @@ def _backward(q, k, v, o, lse, do, cfg: _Config):
             pl.BlockSpec((1, 1, bk_kv, d), lambda b, h, j, i: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+            _out_struct((b, h, lk, d), k.dtype, q, k, v, do),
+            _out_struct((b, h, lk, d), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk_kv, d), jnp.float32),
@@ -352,28 +524,33 @@ def _pick_block(block: int, length: int) -> int:
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, q_offset: int = 0, k_offset: int = 0,
-                    block_q: Optional[int] = None, block_k: int = 1024,
+                    block_q: Optional[int] = None, block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
                     block_k_bwd: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, L, H, D] tensors (same layout/semantics as
     ``ops.attention.dense_attention``, including the shard offsets).
 
-    Three kernels, three block pairs.  Defaults (v5e sweeps, 2026-07-30):
-    the forward auto-selects ``block_q`` 1024 at >= 16k tokens and 512
-    below; BOTH backward passes share the forward blocks — the dkv working
-    set at (1024, 1024) needs the raised ``_VMEM_LIMIT`` scoped-vmem grant
-    (it overflows Mosaic's 16M default by 8K), measured worth ~2-7% at 32k
-    over the old (512, 1024) dkv fallback.  Small blocks lose badly
-    (128 runs at 0.4x dense).
+    Kernel structure and block defaults (v5e device-time sweeps,
+    2026-07-30): the forward uses one full-length block when the [Lq, Lk]
+    score tile fits scoped vmem and (1024, 1024) above that; the backward
+    normally runs as ONE fused kernel producing dq, dk and dv from a
+    single score/probability recompute (25-30% faster than the classic
+    two-kernel backward), preferring (512, 1024) blocks and chunking the
+    q range when its [Lq, D] f32 dq scratch outgrows scoped vmem
+    (``_fused_q_chunks``); the two-kernel path remains as the fallback for
+    shapes that cannot chunk.  Small blocks lose badly (128 runs at 0.4x
+    dense).
 
-    Explicit knobs override: ``block_q``/``block_k`` govern the forward
-    AND (absent bwd overrides) both backward kernels, so one knob tunes
-    everything — e.g. a full-length block on a non-8-divisible sequence,
-    or shrinking all passes out of a scoped-vmem overflow.  Explicit
-    ``block_q_bwd``/``block_k_bwd`` pin both backward kernels (dq and
-    dkv) regardless of the forward.  ``_pick_block`` shrinks every block
-    to fit short sequences automatically.
+    Explicit knobs: ``block_q``/``block_k`` govern the forward kernel;
+    absent bwd overrides the backward AUTO-SELECTS fused-compatible blocks
+    (capped at 1024/512 per ``_fused_bwd_ok``) and only inherits the
+    forward pair verbatim on the non-fused fallback tier — so a >1024
+    forward sweep does NOT reach the backward.  Explicit
+    ``block_q_bwd``/``block_k_bwd`` pin the backward kernels exactly
+    (including forcing it out of the fused path if too large to fit).
+    ``_pick_block`` shrinks every block to fit short sequences
+    automatically.
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     identical kernel code runs (slowly) in CPU tests.
@@ -381,19 +558,37 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    # forward defaults (v5e device-time sweep, 2026-07-30, fwd+bwd with all
+    # grads live): one full-length block when the whole [Lq, Lk] score tile
+    # fits scoped vmem (14% faster than (512, 1024) at 2k — no online
+    # correction passes, no grid overhead), (1024, 1024) above that (5%
+    # faster than (512, 1024) at 8k; [2048, 2048] f32 scores OOM at 8k+)
     if block_q is None:
-        block_q = 1024 if lq >= 16384 else 512
-    if block_q_bwd is None:
-        # both backward kernels track the forward block, auto-upgrade
-        # included: the raised scoped-vmem grant (_VMEM_LIMIT) fits the
-        # (1024, 1024) dkv working set that overflowed the 16M default
-        dq_q = dkv_q = block_q
+        block_q = lq if (lq <= 2048 and lk <= 2048) else 1024
+    if block_k is None:
+        block_k = lk if (lq <= 2048 and lk <= 2048) else 1024
+    if block_q_bwd is None and block_k_bwd is None:
+        # backward defaults aim for the FUSED single-pass backward kernel
+        # (one s/p recompute instead of two — measured 25-30% off the whole
+        # fwd+bwd step on v5e): its dq scratch prefers (512, 1024) blocks,
+        # degrading to (512, 512) and then to the two-kernel path with
+        # forward-inherited blocks as Lq * D grows (see _fused_bwd_ok)
+        if _fused_q_chunks(lq, d, min(block_q, 1024), min(block_k, 1024), lk):
+            dq_q = dkv_q = min(block_q, 1024)
+            dq_k = dkv_k = min(block_k, 1024)
+        elif _fused_q_chunks(lq, d, min(block_q, 512), min(block_k, 1024), lk):
+            dq_q = dkv_q = min(block_q, 512)
+            dq_k = dkv_k = min(block_k, 1024)
+        elif _fused_q_chunks(lq, d, min(block_q, 512), min(block_k, 512), lk):
+            dq_q = dkv_q = min(block_q, 512)
+            dq_k = dkv_k = min(block_k, 512)
+        else:
+            dq_q = dkv_q = block_q
+            dq_k = dkv_k = block_k
     else:
-        dq_q = dkv_q = block_q_bwd
-    if block_k_bwd is None:
-        dq_k = dkv_k = block_k
-    else:
-        dq_k = dkv_k = block_k_bwd
+        dq_q = dkv_q = block_q_bwd if block_q_bwd is not None else block_q
+        dq_k = dkv_k = block_k_bwd if block_k_bwd is not None else block_k
     bq, bk = _pick_block(block_q, lq), _pick_block(block_k, lk)
     bq_dq, bk_dq = _pick_block(dq_q, lq), _pick_block(dq_k, lk)
     bq_kv, bk_kv = _pick_block(dkv_q, lq), _pick_block(dkv_k, lk)

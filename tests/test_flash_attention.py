@@ -146,6 +146,36 @@ def test_odd_block_sizes_fall_back_to_divisors():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_split_backward_fallback_matches_fused():
+    """The two-kernel backward (taken when the fused kernel's [Lq, D] dq
+    scratch would overflow scoped vmem) must produce the same gradients as
+    the fused default."""
+    import distkeras_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(9)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 64, 2, 16)) * 0.1, jnp.float32)
+               for _ in range(3))
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fa.flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True)),
+            argnums=(0, 1, 2))(q, k, v)
+
+    assert fa._fused_bwd_ok(64, 16, 16, 16, 64)
+    fused = grads()
+    caps = fa._FUSED_WIDE_CAP, fa._FUSED_DQ_SCRATCH_CAP
+    try:
+        fa._FUSED_WIDE_CAP = fa._FUSED_DQ_SCRATCH_CAP = 0
+        assert not fa._fused_bwd_ok(64, 16, 16, 16, 64)
+        split = grads()
+    finally:
+        fa._FUSED_WIDE_CAP, fa._FUSED_DQ_SCRATCH_CAP = caps
+    for a, b, name in zip(fused, split, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   err_msg=f"fused/split grad mismatch for {name}")
+
+
 def test_bwd_blocks_inherit_explicit_fwd_blocks():
     """Explicit block_q/block_k govern the backward too (multi-block bwd
     scratch accumulation is exercised), and a full-length block on a
@@ -181,3 +211,58 @@ def test_bwd_blocks_inherit_explicit_fwd_blocks():
     for got, want in zip(g, r):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_chunked_fused_backward_matches_single_call():
+    """Force the q-chunked fused backward (tiny caps) and check gradients
+    against the unchunked default, including the causal q_offset shifts."""
+    import distkeras_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(10)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 64, 2, 16)) * 0.1, jnp.float32)
+               for _ in range(3))
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fa.flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True)),
+            argnums=(0, 1, 2))(q, k, v)
+
+    whole = grads()
+    caps = fa._FUSED_WIDE_CAP, fa._FUSED_DQ_SCRATCH_CAP
+    try:
+        # cap fits 32 rows of d=16 f32 (2K) -> 64-row input must chunk in 2
+        fa._FUSED_WIDE_CAP = fa._FUSED_DQ_SCRATCH_CAP = 32 * 16 * 4
+        assert fa._fused_q_chunks(64, 16, 16, 16, 64) == 2
+        chunked = grads()
+    finally:
+        fa._FUSED_WIDE_CAP, fa._FUSED_DQ_SCRATCH_CAP = caps
+    for a, b, name in zip(whole, chunked, "qkv"):
+        # rtol covers dk/dv cross-chunk summation-order differences
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-8,
+                                   err_msg=f"chunked/whole grad mismatch for {name}")
+
+
+def test_flash_under_dp_shard_map_matches_unsharded():
+    """flash_attention must work inside shard_map with vma checking (the
+    dp-sharded LM train step) — pallas out_shapes need the inputs' vma.
+    Regression: round-3 verify caught ShapeDtypeStruct vma=None errors."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.default_rng(11)
+    q, k, v = _rand_qkv(rng, b=4, l=32, h=2, d=16)
+
+    def fn(q, k, v):
+        def loss(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                           block_k=16, interpret=True))
+        return jax.grad(loss)(q)
+
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("dp",))
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),) * 3,
+                            out_specs=P("dp"))
+    got = sharded(q, k, v)
+    want = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
