@@ -285,38 +285,123 @@ def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int =
 def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256,
                   model_dim: int = 512, num_heads: int = 8, num_layers: int = 8,
                   vocab: int = 8192):
-    """KV-cache autoregressive decode throughput (greedy), tokens/sec.
+    """KV-cache autoregressive decode throughput (greedy), tokens/sec —
+    three modes on the same model family: fp (bf16 activations, f32
+    weights), int8 (weight-only quantized params), and speculative (a
+    2-layer draft proposing k=4 tokens per target verification).
 
     The whole generation (prefill + ``new_tokens`` scanned single-token
     steps) is one compiled program, so the relay dispatch cost amortizes
-    over the full sequence."""
+    over the full sequence.  Speculative runs batch 1 (its decode path is
+    single-sequence); its tokens/sec is NOT comparable to the batched fp
+    number — compare via ``ms_per_token`` against a batch-1 fp run, which
+    is also reported."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from distkeras_tpu.models.base import Model
     from distkeras_tpu.models.decode import make_generate_fn
+    from distkeras_tpu.models.speculative import make_speculative_generate_fn
     from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.ops.quantize import quantize_params
 
     spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim, num_heads=num_heads,
-                         num_layers=num_layers, max_seq_len=prompt_len + new_tokens)
+                         num_layers=num_layers, max_seq_len=prompt_len + new_tokens + 8)
     model = Model.init(spec, seed=0)
-    fn = make_generate_fn(spec, new_tokens)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)), jnp.int32)
     key = jax.random.PRNGKey(0)
 
-    np.asarray(fn(model.params, prompt, key))  # compile + warm
-    t0 = time.perf_counter()
-    np.asarray(fn(model.params, prompt, key))
-    dt = time.perf_counter() - t0
-    return {
-        "batch": batch,
-        "prompt_len": prompt_len,
-        "new_tokens": new_tokens,
-        "tokens_per_sec": round(batch * new_tokens / dt, 1),
-        "ms_per_token": round(dt / new_tokens * 1e3, 3),
-    }
+    def timed(fn, *args, reps: int = 2):
+        np.asarray(fn(*args))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    out = {"batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens}
+
+    fn = make_generate_fn(spec, new_tokens)
+    dt = timed(fn, model.params, prompt, key)
+    out["fp"] = {"tokens_per_sec": round(batch * new_tokens / dt, 1),
+                 "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+
+    qparams = quantize_params(model.params)
+    dt = timed(fn, qparams, prompt, key)
+    out["int8"] = {"tokens_per_sec": round(batch * new_tokens / dt, 1),
+                   "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+
+    # batch-1 legs: fp reference + speculative (draft = 2-layer same-width)
+    dt = timed(fn, model.params, prompt[:1], key)
+    out["fp_b1"] = {"tokens_per_sec": round(new_tokens / dt, 1),
+                    "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+    draft_spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
+                               num_heads=num_heads, num_layers=2,
+                               max_seq_len=prompt_len + new_tokens + 8)
+    draft = Model.init(draft_spec, seed=1)
+    sfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=4)
+    dt = timed(sfn, model.params, draft.params, prompt[:1])
+    out["speculative_b1"] = {"tokens_per_sec": round(new_tokens / dt, 1),
+                             "ms_per_token": round(dt / new_tokens * 1e3, 3),
+                             "draft_layers": 2, "k": 4}
+    return out
+
+
+# (seq_len, batch, model_dim, num_layers, steps) for the LM train legs.
+# The 1024-dim/16-layer leg exists to show WHERE MFU saturates: the
+# 512-dim legs are attention-VPU-bound at head_dim 64, the 1024-dim model
+# (head_dim 128) has 4x the matmul work per attention score.  steps are
+# sized so the ~100ms relay dispatch stays ~1-2% of the reported step.
+# 32k HBM watch-out: in round 2 a 6-step 32k run inside the full bench
+# (after the earlier legs' HBM pressure) once degraded ~25x to 24s/step;
+# the fused backward's smaller footprint made 8 steps measure sane
+# (692ms/step, round-3 full-bench run), but if the 32k leg ever reports a
+# wildly slow step again, suspect HBM pressure from the preceding legs
+# first and drop its step count back down.
+_LM_LEGS = (
+    (2048, 8, 512, 8, 100),
+    (8192, 2, 512, 8, 50),
+    (32768, 1, 512, 8, 8),
+    (2048, 4, 1024, 16, 30),
+)
+
+
+def _leg_ratio(current: float, base: float):
+    """current/base rounded, or None when either side is missing/zero."""
+    if not current or not base:
+        return None
+    return round(current / base, 4)
+
+
+def _apply_leg_baselines(out: dict, baseline: dict) -> None:
+    """Attach per-leg ``vs_baseline`` ratios (throughput ratios, > 1 means
+    faster than the recorded best) so an MFU/decode regression trips
+    visibly.  Legs are matched by config key; a methodology or config
+    change simply finds no match and reports no ratio."""
+    for leg in out.get("lm", ()):
+        key = f"lm:{leg.get('seq_len')}x{leg.get('batch')}:d{leg.get('model_dim', 512)}"
+        base = baseline.get("legs", {}).get(key, {})
+        r = _leg_ratio(leg.get("tokens_per_sec"), base.get("tokens_per_sec"))
+        if r is not None:
+            leg["vs_baseline"] = r
+    for leg in out.get("attn", ()):
+        key = f"attn:{leg.get('seq_len')}"
+        base = baseline.get("legs", {}).get(key, {})
+        # ms ratio inverted so > 1 still means "faster than baseline"
+        r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
+        if r is not None:
+            leg["vs_baseline"] = r
+    dec = out.get("decode", {})
+    for mode in ("fp", "int8", "fp_b1", "speculative_b1"):
+        sub = dec.get(mode)
+        base = baseline.get("legs", {}).get(f"decode:{mode}", {})
+        if isinstance(sub, dict):
+            r = _leg_ratio(sub.get("tokens_per_sec"), base.get("tokens_per_sec"))
+            if r is not None:
+                sub["vs_baseline"] = r
 
 
 def main() -> None:
@@ -339,27 +424,28 @@ def main() -> None:
 
         baseline_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
-        vs = 1.0
+        baseline = {}
         if os.path.exists(baseline_path):
             with open(baseline_path) as f:
                 baseline = json.load(f)
-            base = baseline.get("value")
-            base_method = baseline.get("methodology")
-            if base and baseline.get("platform", "tpu") != platform:
-                # CPU-fallback throughput vs a TPU baseline is meaningless;
-                # skip the ratio (keep 1.0) and flag why
-                out["vs_baseline_note"] = (
-                    f"baseline recorded on {baseline.get('platform', 'tpu')}; "
-                    f"this run on {platform} — ratio not computed")
-            elif base and base_method != _METHODOLOGY:
-                # a ratio across bench-methodology changes measures the
-                # measurement, not the chip (the round-2 dispatch-overhead
-                # fix alone moved the same model 539k -> 934k)
-                out["vs_baseline_note"] = (
-                    f"baseline methodology {base_method!r} != {_METHODOLOGY!r}"
-                    " — ratio not computed")
-            elif base:
-                vs = sps_per_chip / base
+        vs = 1.0
+        base = baseline.get("value")
+        base_method = baseline.get("methodology")
+        if base and baseline.get("platform", "tpu") != platform:
+            # CPU-fallback throughput vs a TPU baseline is meaningless;
+            # skip the ratio (keep 1.0) and flag why
+            out["vs_baseline_note"] = (
+                f"baseline recorded on {baseline.get('platform', 'tpu')}; "
+                f"this run on {platform} — ratio not computed")
+        elif base and base_method != _METHODOLOGY:
+            # a ratio across bench-methodology changes measures the
+            # measurement, not the chip (the round-2 dispatch-overhead
+            # fix alone moved the same model 539k -> 934k)
+            out["vs_baseline_note"] = (
+                f"baseline methodology {base_method!r} != {_METHODOLOGY!r}"
+                " — ratio not computed")
+        elif base:
+            vs = sps_per_chip / base
         out["vs_baseline"] = round(vs, 6)
 
         if platform == "tpu":
@@ -371,16 +457,16 @@ def main() -> None:
             # pressure from earlier legs once blew the 32k LM leg up 25x
             gc.collect()
             lm, attn = [], []
-            # steps sized so per-step relay overhead (~100ms/dispatch) stays
-            # under ~3% of the reported ms_per_step at each length
-            # 32768 stays at 4 steps: a 6-step run inside the full bench once
-            # blew up to 24s/step (HBM pressure after the earlier legs); at
-            # ~960ms/step the dispatch overhead is <3% anyway
-            for seq, batch, steps in ((2048, 8, 40), (8192, 2, 20), (32768, 1, 4)):
+            for seq, batch, model_dim, num_layers, steps in _LM_LEGS:
                 try:
-                    lm.append(_bench_lm(seq, batch, steps=steps))
+                    leg = _bench_lm(seq, batch, model_dim=model_dim,
+                                    num_heads=8, num_layers=num_layers,
+                                    steps=steps)
+                    leg["model_dim"] = model_dim
+                    lm.append(leg)
                 except Exception as e:
-                    lm.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
+                    lm.append({"seq_len": seq, "model_dim": model_dim,
+                               "error": f"{type(e).__name__}: {e}"})
                 gc.collect()
             for seq, steps in ((2048, 50), (8192, 25)):
                 try:
@@ -394,6 +480,7 @@ def main() -> None:
                 out["decode"] = _bench_decode()
             except Exception as e:
                 out["decode"] = {"error": f"{type(e).__name__}: {e}"}
+            _apply_leg_baselines(out, baseline)
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,
         out["vs_baseline"] = 0.0  # even if a sub-step already set a value

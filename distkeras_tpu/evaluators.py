@@ -32,7 +32,18 @@ class AccuracyEvaluator(Evaluator):
         self.label_col = label_col
 
         def acc(pred, label):
-            return jnp.mean((_pred_to_index(pred) == _to_index(label)).astype(jnp.float32))
+            p, l = _pred_to_index(pred), _to_index(label)
+            if p.shape != l.shape:
+                # e.g. an INTEGER-dtype one-hot label column: integer arrays
+                # are always treated as class indices (so (B, T) token
+                # labels survive), which would otherwise broadcast into a
+                # silently wrong accuracy whenever shapes happen to align
+                raise ValueError(
+                    f"prediction indices {p.shape} vs label indices {l.shape}: "
+                    "shapes must match after index conversion. Integer label "
+                    "columns are taken as class indices whatever their rank — "
+                    "convert one-hot labels to float, or argmax them first")
+            return jnp.mean((p == l).astype(jnp.float32))
 
         self._fn = jax.jit(acc)
 

@@ -349,6 +349,15 @@ class AsyncAEASGD(AsyncDistributedTrainer):
 
     def __init__(self, model, rho: float = 5.0, communication_window: int = 32, **kwargs):
         super().__init__(model, communication_window=communication_window, **kwargs)
+        if callable(self.learning_rate):
+            # same guard (and workaround guidance) as the sync AEASGD: a
+            # schedule would otherwise surface as a raw float * function
+            # TypeError on the next line
+            raise ValueError(
+                "elastic trainers need a scalar learning_rate (the elastic "
+                "coupling alpha = rho * lr is a constant); to schedule the "
+                "local steps, pass an optax optimizer built with the schedule "
+                "as worker_optimizer and keep learning_rate scalar")
         self.rho = float(rho)
         self.alpha = self.rho * self.learning_rate
 

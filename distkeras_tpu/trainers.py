@@ -88,7 +88,8 @@ class Trainer:
 
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None,
-              validation_data: Optional[Dataset] = None) -> Model:  # pragma: no cover - interface
+              validation_data: Optional[Dataset] = None,
+              early_stopping=None) -> Model:  # pragma: no cover - interface
         raise NotImplementedError
 
     def _profile_ctx(self):
@@ -182,10 +183,11 @@ class Trainer:
 
     class _EarlyStopping:
         """Keras-``EarlyStopping`` semantics over the per-epoch validation
-        metrics: stop after ``patience`` epochs without ``min_delta``
-        improvement on ``monitor`` (val_loss: lower is better;
-        val_accuracy: higher).  ``restore_best=True`` (default) hands the
-        best-epoch weights back instead of the last ones."""
+        metrics: stop once ``patience`` consecutive epochs pass without a
+        ``min_delta`` improvement on ``monitor`` (val_loss: lower is
+        better; val_accuracy: higher; ``patience=0`` behaves like 1, as in
+        Keras).  ``restore_best=True`` (default) hands the best-epoch
+        weights back instead of the last ones."""
 
         def __init__(self, patience: int = 3, min_delta: float = 0.0,
                      monitor: str = "val_loss", restore_best: bool = True):
@@ -220,7 +222,7 @@ class Trainer:
                     self.best_params = jax.tree.map(np.asarray, params)
             else:
                 self.stale += 1
-                if self.stale > self.patience:
+                if self.stale >= max(self.patience, 1):
                     self.stopped_epoch = epoch
                     return True
             return False
@@ -268,8 +270,19 @@ class SingleTrainer(Trainer):
 
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None,
-              validation_data: Optional[Dataset] = None) -> Model:
+              validation_data: Optional[Dataset] = None,
+              early_stopping=None) -> Model:
+        """``early_stopping``: None, a ``Trainer._EarlyStopping``, or a dict
+        of its kwargs (``patience``/``min_delta``/``monitor``/
+        ``restore_best``) — Keras-EarlyStopping semantics over the per-epoch
+        validation metrics (requires ``validation_data=``)."""
         self.record_training_start()
+        stopper = self._early_stopper(early_stopping)
+        if stopper is not None and validation_data is None:
+            raise ValueError(
+                "early_stopping monitors validation metrics; pass "
+                "validation_data= (failing now beats training a full epoch "
+                "before the missing metric is noticed)")
         # cached across train() calls: scan_epoch_fn returns a fresh jit
         # closure each time, which would defeat the jit cache and recompile
         # on every call (callers like the baseline runner call train() once
@@ -326,6 +339,10 @@ class SingleTrainer(Trainer):
                 if checkpointer is not None:
                     checkpointer.save(epoch + 1, {"params": params, "opt_state": opt_state},
                                       metadata={"epochs_done": epoch + 1})
+                if stopper is not None and stopper.update(epoch, self.metrics[-1], params):
+                    if stopper.restore_best and stopper.best_params is not None:
+                        params = jax.tree.map(jnp.asarray, stopper.best_params)
+                    break
         self.model = Model(spec=self.model.spec, params=params)
         self.record_training_end()
         return self.model
@@ -375,7 +392,15 @@ class DistributedTrainer(Trainer):
 
     def _run_epochs(self, dataset: Dataset, shuffle: bool,
                     checkpointer: Optional[Checkpointer] = None,
-                    validation_data: Optional[Dataset] = None) -> Any:
+                    validation_data: Optional[Dataset] = None,
+                    early_stopping=None) -> Any:
+        stopper = self._early_stopper(early_stopping)
+        if stopper is not None and validation_data is None:
+            raise ValueError(
+                "early_stopping monitors validation metrics; pass "
+                "validation_data= (failing now beats training a full epoch "
+                "before the missing metric is noticed)")
+        self._es_best_params = None  # set when early stopping restores best
         engine = self.engine
         state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
         start_epoch = 0
@@ -409,20 +434,32 @@ class DistributedTrainer(Trainer):
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
                                            chips=self.num_workers)
                 if validation_data is not None:
-                    val = self._validate(self._validation_params(state),
-                                         validation_data)
+                    vparams = self._validation_params(state)
+                    val = self._validate(vparams, validation_data)
                     self.metrics[-1].update(val)
                 if checkpointer is not None:
                     checkpointer.save(epoch + 1, {"state": state},
                                       metadata={"epochs_done": epoch + 1})
+                if stopper is not None and stopper.update(
+                        epoch, self.metrics[-1], vparams):
+                    if stopper.restore_best and stopper.best_params is not None:
+                        self._es_best_params = stopper.best_params
+                    break
         return state
 
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None,
-              validation_data: Optional[Dataset] = None) -> Model:
+              validation_data: Optional[Dataset] = None,
+              early_stopping=None) -> Model:
+        """``early_stopping``: see ``SingleTrainer.train`` — monitored on
+        the center/average params the trainer would hand back."""
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data)
+        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data,
+                                 early_stopping=early_stopping)
         self.model = self.engine.center_model(state)
+        if getattr(self, "_es_best_params", None) is not None:
+            self.model = Model(spec=self.model.spec,
+                               params=jax.tree.map(jnp.asarray, self._es_best_params))
         self.record_training_end()
         return self.model
 
@@ -494,10 +531,15 @@ class AveragingTrainer(DistributedTrainer):
 
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None,
-              validation_data: Optional[Dataset] = None) -> Model:
+              validation_data: Optional[Dataset] = None,
+              early_stopping=None) -> Model:
         self.record_training_start()
-        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data)
+        state = self._run_epochs(dataset, shuffle, checkpointer, validation_data,
+                                 early_stopping=early_stopping)
         self.model = self.engine.averaged_model(state)
+        if getattr(self, "_es_best_params", None) is not None:
+            self.model = Model(spec=self.model.spec,
+                               params=jax.tree.map(jnp.asarray, self._es_best_params))
         self.record_training_end()
         return self.model
 
@@ -524,12 +566,14 @@ class EnsembleTrainer(DistributedTrainer):
 
     def train(self, dataset: Dataset, shuffle: bool = True,
               checkpointer: Optional[Checkpointer] = None,
-              validation_data: Optional[Dataset] = None) -> List[Model]:  # type: ignore[override]
-        if validation_data is not None:
+              validation_data: Optional[Dataset] = None,
+              early_stopping=None) -> List[Model]:  # type: ignore[override]
+        if validation_data is not None or early_stopping is not None:
             raise ValueError(
-                "per-epoch validation is ambiguous for an ensemble (N "
-                "independent members, no single center); evaluate the "
-                "returned models with ModelPredictor/AccuracyEvaluator")
+                "per-epoch validation (and early stopping on it) is "
+                "ambiguous for an ensemble (N independent members, no "
+                "single center); evaluate the returned models with "
+                "ModelPredictor/AccuracyEvaluator")
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer)
         models = self.engine.local_models(state)

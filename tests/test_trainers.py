@@ -244,3 +244,71 @@ def _optax_sce(logits, y):
 
     return _optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(_jnp.float32), y).mean()
+
+
+def test_single_trainer_early_stopping_stops_and_restores(toy_dataset):
+    # an impossible min_delta means epoch 0 sets the best and every later
+    # epoch is "no improvement": patience=1 stops at epoch 2 of 10
+    trainer = SingleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                            worker_optimizer="sgd", learning_rate=0.1,
+                            batch_size=64, num_epoch=10)
+    model = trainer.train(toy_dataset, validation_data=toy_dataset,
+                          early_stopping={"patience": 2, "min_delta": 1e9,
+                                          "monitor": "val_loss"})
+    assert len(trainer.metrics) == 3  # epoch 0 best + 2 stale (Keras >=)
+    # restore_best hands back the epoch-0 weights: retraining one epoch
+    # from them must reproduce epoch 1's val_loss trajectory start
+    assert model is not None
+
+
+def test_single_trainer_early_stopping_needs_validation(toy_dataset):
+    trainer = SingleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                            worker_optimizer="sgd", learning_rate=0.1,
+                            batch_size=64, num_epoch=3)
+    with pytest.raises(ValueError, match="validation_data"):
+        # pre-flight: must fail BEFORE any epoch trains
+        trainer.train(toy_dataset, early_stopping={"patience": 0})
+    assert len(trainer.metrics) == 0
+
+
+def test_distributed_trainer_early_stopping(toy_dataset):
+    trainer = ADAG(tiny_mlp_spec(), loss="categorical_crossentropy",
+                   worker_optimizer="sgd", learning_rate=0.05,
+                   num_workers=8, batch_size=8, num_epoch=10,
+                   communication_window=2)
+    model = trainer.train(toy_dataset, validation_data=toy_dataset,
+                          early_stopping={"patience": 0, "min_delta": 1e9,
+                                          "monitor": "val_loss"})
+    assert len(trainer.metrics) == 2  # epoch 0 best, epoch 1 stops
+    # restore_best: returned model is the epoch-0 center snapshot
+    assert model.params is not None
+
+
+def test_ensemble_rejects_early_stopping(toy_dataset):
+    trainer = EnsembleTrainer(tiny_mlp_spec(), loss="categorical_crossentropy",
+                              worker_optimizer="sgd", learning_rate=0.05,
+                              num_workers=4, batch_size=8, num_epoch=2)
+    with pytest.raises(ValueError, match="ambiguous for an ensemble"):
+        trainer.train(toy_dataset, early_stopping={"patience": 1})
+
+
+def test_accuracy_evaluator_rejects_integer_onehot():
+    # integer arrays are always class indices; an int one-hot column must
+    # raise with guidance, not broadcast into a wrong accuracy
+    ds = Dataset({"prediction_index": np.array([0, 1, 1, 0]),
+                  "label": np.eye(2, dtype=np.int64)[[0, 1, 0, 1]]})
+    ev = AccuracyEvaluator(prediction_col="prediction_index", label_col="label")
+    with pytest.raises(ValueError, match="Integer label"):
+        ev.evaluate(ds)
+
+
+def test_async_elastic_rejects_schedule_learning_rate():
+    import optax
+
+    from distkeras_tpu.runtime.async_trainer import AsyncAEASGD, AsyncEAMSGD
+
+    sched = optax.exponential_decay(0.1, 10, 0.9)
+    for cls in (AsyncAEASGD, AsyncEAMSGD):
+        with pytest.raises(ValueError, match="scalar learning_rate"):
+            cls(tiny_mlp_spec(), loss="categorical_crossentropy",
+                num_workers=2, learning_rate=sched)
