@@ -47,53 +47,125 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: str,
-                   causal: bool = True) -> jnp.ndarray:
+                   causal: bool = True, impl: Optional[str] = None) -> jnp.ndarray:
     """Sequence-parallel attention under ``shard_map`` over ``axis_name``.
 
     Each caller holds the local sequence shard: q/k/v [B, L_local, H, D].
     KV blocks rotate around the ring; the block held at step ``s`` is the
-    one that originated on rank ``(my_rank - s) mod sp``. Softmax is
-    accumulated online in float32 for stability.
+    one that originated on rank ``(my_rank - s) mod sp``.
+
+    TPU-grade schedule (round 3):
+
+    - the per-step block compute is the Pallas flash kernel via
+      ``flash_attention_with_lse`` (bf16 matmuls at MXU rate, f32
+      softmax stats) instead of dense f32 XLA attention;
+    - under causal masking only step 0 needs a mask at all: a LIVE step
+      ``s > 0`` holds kv from rank ``my - s`` — strictly the past, every
+      position visible — so it runs the cheaper non-causal kernel, and a
+      DEAD step (``src > my``: kv entirely in this rank's future, about
+      half of all (rank, step) pairs) skips the kernel entirely behind
+      ``lax.cond`` — the per-device predicate is local control flow, only
+      the ``ppermute`` rotation stays unconditional;
+    - per-step (o_s, lse_s) partials merge online in float32:
+      ``out = sum_s o_s * exp(lse_s - M) / sum_s exp(lse_s - M)`` with a
+      running max M, so per-chip memory stays O(L_local) and gradients
+      flow exactly through both outputs (the lse cotangent folds into the
+      flash backward as a delta shift).
+
+    ``impl``: ``None`` auto-selects — the flash kernel on TPU for shards
+    long enough to win (measured v5e crossover: 2.04x at l_local=4096,
+    1.42x at 2048, 0.65x at 1024 — small blocks can't amortize the
+    kernel's VPU overhead), dense-XLA otherwise (including CPU meshes,
+    where interpret-mode flash is also prohibitively slow for tests).
+    ``"flash"``/``"dense"`` force a path (CPU flash-ring composition
+    tests; numerical cross-checks).
     """
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    q32 = q.astype(jnp.float32)
+    if impl is None:
+        use_flash = (jax.default_backend() == "tpu" and l_local >= 2048
+                     and l_local % 128 == 0)
+    elif impl in ("flash", "dense"):
+        use_flash = impl == "flash"
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}: expected 'flash' or 'dense'")
 
-    q_pos = my * l_local + jnp.arange(l_local)
+    def block_attn(k_blk, v_blk, step_causal):
+        # one (o, lse) partial for the local q block against one kv block;
+        # lse is log-sum-exp of the scaled scores [B, H, Lq].  The flash
+        # kernel always runs causal=True: a live step s > 0 passes
+        # q_offset=l_local so every key is provably in the past and the
+        # kernel's mask takes its identity branch everywhere (same cost as
+        # an unmasked kernel, and it sidesteps a pallas-interpreter vma
+        # bug that trips the causal=False kernel under shard_map on CPU)
+        if use_flash:
+            from distkeras_tpu.ops.flash_attention import flash_attention_with_lse
 
-    def step(carry, s):
-        m, l_sum, acc, k_blk, v_blk = carry
-        src = (my - s) % sp  # global rank the current kv block came from
-        k_pos = src * l_local + jnp.arange(l_local)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
-        blk_max = jnp.max(logits, axis=-1)  # [B,H,Lq]
-        new_m = jnp.maximum(m, blk_max)
-        # guard: fully-masked rows produce -inf max; keep exp well-defined
-        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
-        probs = jnp.exp(logits - safe_m[..., None])  # [B,H,Lq,Lk]
-        new_l = l_sum * correction + jnp.sum(probs, axis=-1)
-        blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_blk.astype(jnp.float32))
-        new_acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
-        # rotate kv one hop around the ring (rank r -> r+1)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
-        k_next = lax.ppermute(k_blk, axis_name, perm)
-        v_next = lax.ppermute(v_blk, axis_name, perm)
-        return (new_m, new_l, new_acc, k_next, v_next), None
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=True,
+                                            q_offset=0 if step_causal else l_local,
+                                            k_offset=0)
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k_blk.astype(jnp.float32)) * scale
+        if step_causal:
+            pos = jnp.arange(l_local)
+            logits = jnp.where((pos[:, None] >= pos[None, :])[None, None], logits,
+                               -jnp.inf)
+        m = jnp.max(logits, axis=-1)
+        p = jnp.exp(logits - m[..., None])
+        l_sum = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        # stay in f32: the merge accumulates in f32 anyway, and the dense
+        # branch doubles as the exact reference for numerical cross-checks
+        return o / l_sum.transpose(0, 2, 1)[..., None], m + jnp.log(l_sum)
+
+    # constants entering per-device results must carry q's full varying set
+    # (covers extra mesh axes like dp) or cond/accumulation types mismatch
+    vma = tuple(jax.typeof(q).vma) or (axis_name,)
+
+    def live_step(k_blk, v_blk, step_causal):
+        o_s, lse_s = block_attn(k_blk, v_blk, step_causal)
+        return o_s.astype(jnp.float32), lse_s
+
+    def dead_step(k_blk, v_blk):
+        return tuple(lax.pcast(x, vma, to="varying") for x in (
+            jnp.zeros((b, l_local, h, d), jnp.float32),
+            jnp.full((b, h, l_local), -jnp.inf, jnp.float32)))
 
     m0 = jnp.full((b, h, l_local), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, l_local), dtype=jnp.float32)
     acc0 = jnp.zeros((b, l_local, h, d), dtype=jnp.float32)
-    # accumulators become device-varying on the first scan step; mark them
-    # with q's full varying set (covers extra mesh axes like dp)
-    vma = tuple(jax.typeof(q).vma) or (axis_name,)
     m0, l0, acc0 = (lax.pcast(x, vma, to="varying") for x in (m0, l0, acc0))
-    (m, l_sum, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(sp))
+
+    m, l_sum, acc = m0, l0, acc0
+    k_blk, v_blk = k, v
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    # python loop: sp is static, and a static step index makes step 0 the
+    # ONLY masked kernel (the scan-based version had to mask every step)
+    for s in range(sp):
+        if s:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (my - s) % sp  # global rank the current kv block came from
+        if causal and s:
+            # step_causal is static (False for s > 0: the kv block is
+            # strictly in the past), so it closes over the branches rather
+            # than riding the cond operands
+            o_s, lse_s = lax.cond(src <= my,
+                                  lambda kb, vb: live_step(kb, vb, False),
+                                  dead_step, k_blk, v_blk)
+        else:
+            o_s, lse_s = live_step(k_blk, v_blk, causal)
+        new_m = jnp.maximum(m, lse_s)
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+        w = jnp.exp(jnp.where(jnp.isneginf(lse_s), -jnp.inf, lse_s - safe_m))
+        l_sum = l_sum * corr + w
+        wq = w.transpose(0, 2, 1)[..., None]      # [B, Lq, H, 1]
+        corrq = corr.transpose(0, 2, 1)[..., None]
+        acc = acc * corrq + o_s * wq
+        m = new_m
     denom = jnp.maximum(l_sum, 1e-20).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
 
@@ -110,18 +182,14 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
     falling back would silently attend within each local shard, so that is
     an error instead.
 
-    ``impl``: ``"flash"`` forces the Pallas flash kernel on the dense path,
-    ``"dense"`` forces plain XLA softmax attention, ``None`` auto-selects
-    flash on TPU for sequences long enough to benefit (the kernel skips
-    masked key blocks and never materializes [Lq, Lk]).
+    ``impl``: ``"flash"`` forces the Pallas flash kernel, ``"dense"``
+    forces plain XLA softmax attention, ``None`` auto-selects flash on TPU
+    for sequences long enough to benefit (the kernel skips masked key
+    blocks and never materializes [Lq, Lk]).  Under sequence parallelism
+    the schedule is always ring attention and ``impl`` selects its
+    per-block compute (``ring_attention``'s own crossover applies when
+    ``None``).
     """
-    if axis_name is not None and jax.typeof(q).vma:
-        # sequence-parallel path: the schedule is ring attention; a forced
-        # per-block impl is not honored here, so reject rather than ignore
-        if impl is not None:
-            raise ValueError(
-                f"impl={impl!r} is not supported under sequence parallelism "
-                f"(axis {axis_name!r} is bound): the schedule is ring attention")
     if axis_name is not None and not jax.typeof(q).vma:
         axis_name = None  # traced outside any shard_map: dense is exact
     if axis_name is None:
@@ -149,4 +217,6 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
             f"sequence axis {axis_name!r} is not bound by the enclosing shard_map "
             f"(bound varying axes: {sorted(jax.typeof(q).vma)}); the model's seq_axis "
             f"must match the mesh axis the sequence is sharded over") from None
-    return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    # the schedule is ring attention; impl selects its PER-BLOCK compute
+    # (flash kernel vs dense XLA), auto-selected by shard length when None
+    return ring_attention(q, k, v, axis_name=axis_name, causal=causal, impl=impl)

@@ -414,15 +414,20 @@ def _fused_q_chunks(lq: int, d: int, bq_kv: int, bk_kv: int, lk: int):
     return None
 
 
-def _backward(q, k, v, o, lse, do, cfg: _Config):
+def _backward(q, k, v, o, lse, do, cfg: _Config, dlse=None):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bq, bk = cfg.block_q_dq, cfg.block_k_dq
     bq_kv, bk_kv = cfg.block_q_bwd, cfg.block_k_bwd
     scale = 1.0 / (d ** 0.5)
     # delta[b, h, i] = sum_d dO * O — the softmax-jacobian row term; tiny
-    # elementwise reduce, XLA fuses it, no kernel needed
+    # elementwise reduce, XLA fuses it, no kernel needed.  When the caller
+    # also differentiates the lse OUTPUT (flash_attention_with_lse), its
+    # cotangent folds into the same kernels: dL/ds = p * (dp - delta + dlse)
+    # — i.e. the kernels just see delta' = delta - dlse
     delta = jnp.einsum("bhld,bhld->bhl", do.astype(jnp.float32), o.astype(jnp.float32))
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (b, h, lq, _STAT_LANES))
 
     n_chunks = _fused_q_chunks(lq, d, bq_kv, bk_kv, lk)
@@ -515,6 +520,26 @@ def _flash_bwd(cfg: _Config, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_lse(q, k, v, cfg: _Config):
+    o, lse = _forward(q, k, v, cfg)
+    return o, lse[..., 0]
+
+
+def _flash_lse_fwd(q, k, v, cfg: _Config):
+    o, lse = _forward(q, k, v, cfg)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(cfg: _Config, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    return _backward(q, k, v, o, lse, do, cfg, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def _pick_block(block: int, length: int) -> int:
     block = min(block, length)
     while length % block:
@@ -555,6 +580,43 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     identical kernel code runs (slowly) in CPU tests.
     """
+    cfg = _make_config(q, k, causal, q_offset, k_offset, block_q, block_k,
+                       block_q_bwd, block_k_bwd, interpret)
+    # [B, L, H, D] -> [B, H, L, D] for the kernels; the transposes sit outside
+    # the custom_vjp so their adjoints are handled by XLA
+    o = _flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2), cfg)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def flash_attention_with_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                             causal: bool = True, q_offset: int = 0,
+                             k_offset: int = 0,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
+                             block_q_bwd: Optional[int] = None,
+                             block_k_bwd: Optional[int] = None,
+                             interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp of the scaled scores: ``(o [B, L, H, D], lse [B, H, L]
+    float32)``.
+
+    The pair is exactly what blockwise/ring composition needs — partial
+    attentions over kv blocks merge as ``out = sum_s o_s * exp(lse_s - M)
+    / sum_s exp(lse_s - M)`` — and BOTH outputs are differentiable: the
+    lse cotangent folds into the same backward kernels as a delta shift
+    (see ``_backward``), so ``ops.attention.ring_attention`` gets exact
+    gradients through the merge.  Fully-masked rows report lse 0 (finite
+    sentinel) and o exactly 0, matching ``flash_attention``.
+    """
+    cfg = _make_config(q, k, causal, q_offset, k_offset, block_q, block_k,
+                       block_q_bwd, block_k_bwd, interpret)
+    o, lse = _flash_lse(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                        jnp.swapaxes(v, 1, 2), cfg)
+    return jnp.swapaxes(o, 1, 2), lse
+
+
+def _make_config(q, k, causal, q_offset, k_offset, block_q, block_k,
+                 block_q_bwd, block_k_bwd, interpret) -> _Config:
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
@@ -604,11 +666,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 f"largest fitting divisor is {blk}, which is neither "
                 f"8-divisible nor the full length; pad the sequence or use "
                 f"impl='dense'")
-    cfg = _Config(causal=bool(causal), q_offset=int(q_offset), k_offset=int(k_offset),
-                  block_q=bq, block_k=bk, block_q_dq=bq_dq, block_k_dq=bk_dq,
-                  block_q_bwd=bq_kv, block_k_bwd=bk_kv,
-                  interpret=bool(interpret))
-    # [B, L, H, D] -> [B, H, L, D] for the kernels; the transposes sit outside
-    # the custom_vjp so their adjoints are handled by XLA
-    o = _flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2), cfg)
-    return jnp.swapaxes(o, 1, 2)
+    return _Config(causal=bool(causal), q_offset=int(q_offset), k_offset=int(k_offset),
+                   block_q=bq, block_k=bk, block_q_dq=bq_dq, block_k_dq=bk_dq,
+                   block_q_bwd=bq_kv, block_k_bwd=bk_kv,
+                   interpret=bool(interpret))

@@ -390,6 +390,15 @@ class DistributedTrainer(Trainer):
         (AveragingTrainer scores the average of the replicas)."""
         return self.engine.center_model(state).params
 
+    def _restore_best(self, model: Model) -> Model:
+        """Swap in the early-stopping best-epoch weights when a stop
+        recorded them; shared by every train() so subclasses overriding
+        train() cannot silently drop restoration."""
+        if getattr(self, "_es_best_params", None) is not None:
+            return Model(spec=self.model.spec,
+                         params=jax.tree.map(jnp.asarray, self._es_best_params))
+        return model
+
     def _run_epochs(self, dataset: Dataset, shuffle: bool,
                     checkpointer: Optional[Checkpointer] = None,
                     validation_data: Optional[Dataset] = None,
@@ -456,10 +465,7 @@ class DistributedTrainer(Trainer):
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer, validation_data,
                                  early_stopping=early_stopping)
-        self.model = self.engine.center_model(state)
-        if getattr(self, "_es_best_params", None) is not None:
-            self.model = Model(spec=self.model.spec,
-                               params=jax.tree.map(jnp.asarray, self._es_best_params))
+        self.model = self._restore_best(self.engine.center_model(state))
         self.record_training_end()
         return self.model
 
@@ -536,10 +542,7 @@ class AveragingTrainer(DistributedTrainer):
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer, validation_data,
                                  early_stopping=early_stopping)
-        self.model = self.engine.averaged_model(state)
-        if getattr(self, "_es_best_params", None) is not None:
-            self.model = Model(spec=self.model.spec,
-                               params=jax.tree.map(jnp.asarray, self._es_best_params))
+        self.model = self._restore_best(self.engine.averaged_model(state))
         self.record_training_end()
         return self.model
 

