@@ -174,8 +174,34 @@ class WindowEngine:
 
     def shard_state(self, state: ReplicaState) -> ReplicaState:
         """Place a (host or restored-from-checkpoint) state onto the mesh
-        with this engine's shardings."""
-        return jax.device_put(state, self._state_shardings())
+        with this engine's shardings.
+
+        Multi-process (``jax.distributed`` initialized, mesh spanning
+        hosts): every process holds the same full host-side state and
+        contributes just its addressable shards via
+        ``make_array_from_callback`` — ``device_put`` cannot place onto
+        non-addressable devices."""
+        shardings = self._state_shardings()
+        if jax.process_count() == 1:
+            return jax.device_put(state, shardings)
+
+        def put(subtree, sharding):
+            # one sharding per ReplicaState FIELD (device_put broadcasts
+            # prefix trees itself; make_array_from_callback does not)
+            def leaf(l):
+                host = np.asarray(l)
+                return jax.make_array_from_callback(
+                    host.shape, sharding, lambda idx, h=host: h[idx])
+
+            return jax.tree.map(leaf, subtree)
+
+        return ReplicaState(
+            center=put(state.center, shardings.center),
+            local=put(state.local, shardings.local),
+            opt_state=put(state.opt_state, shardings.opt_state),
+            extra=put(state.extra, shardings.extra),
+            step=put(state.step, shardings.step),
+        )
 
     # -- compiled epoch --------------------------------------------------------
     def _build_epoch_fn(self) -> Callable:
@@ -249,8 +275,17 @@ class WindowEngine:
         Returns (new_state, per-window mean losses as numpy).
         """
         sharding = self.data_sharding()
-        xs_d = jax.device_put(xs, sharding)
-        ys_d = jax.device_put(ys, sharding)
+        if jax.process_count() > 1:
+            # every process passes the same GLOBAL chunk; this process
+            # contributes the batch columns its devices own (exact parity
+            # with the single-process replica->rows assignment, which a
+            # contiguous dataset-level shard would not give)
+            lo, hi = self._local_batch_range(xs.shape[2])
+            xs_d = jax.make_array_from_process_local_data(sharding, xs[:, :, lo:hi])
+            ys_d = jax.make_array_from_process_local_data(sharding, ys[:, :, lo:hi])
+        else:
+            xs_d = jax.device_put(xs, sharding)
+            ys_d = jax.device_put(ys, sharding)
         if keys is None:
             # any constant is a valid (unused) threefry key when the spec
             # has no rng need; a real run with needs_rng must pass keys
@@ -258,8 +293,35 @@ class WindowEngine:
                 raise ValueError("this engine's spec needs per-batch dropout "
                                  "keys; pass keys=[num_windows, window, 2]")
             keys = np.zeros(xs.shape[:2] + (2,), np.uint32)
-        state, losses = self._epoch_fn(state, xs_d, ys_d, jnp.asarray(keys))
+        keys = np.asarray(keys)
+        if jax.process_count() > 1:
+            keys_sh = NamedSharding(self.mesh, P())
+            keys_d = jax.make_array_from_process_local_data(keys_sh, keys)
+        else:
+            keys_d = jnp.asarray(keys)
+        state, losses = self._epoch_fn(state, xs_d, ys_d, keys_d)
         return state, np.asarray(losses)
+
+    def _local_batch_range(self, global_batch: int):
+        """Global-batch column range owned by this process's devices (the
+        replica axis shards the batch dim in mesh-device order)."""
+        devs = list(self.mesh.devices.ravel())
+        if global_batch % len(devs):
+            # single-process device_put raises on this; fail identically
+            # instead of silently dropping the trailing columns
+            raise ValueError(
+                f"global batch {global_batch} is not divisible by the "
+                f"{len(devs)}-device mesh; pad or resize the batch")
+        per = global_batch // len(devs)
+        mine = [i for i, d in enumerate(devs)
+                if d.process_index == jax.process_index()]
+        if not mine:
+            raise RuntimeError("this process owns no devices of the engine mesh")
+        if mine != list(range(mine[0], mine[-1] + 1)):
+            raise NotImplementedError(
+                f"non-contiguous local device placement {mine} in the mesh; "
+                "build the mesh from jax.devices() order")
+        return mine[0] * per, (mine[-1] + 1) * per
 
     # -- results ---------------------------------------------------------------
     def center_model(self, state: ReplicaState) -> Model:
@@ -268,6 +330,11 @@ class WindowEngine:
 
     def local_models(self, state: ReplicaState) -> List[Model]:
         """All per-replica models (EnsembleTrainer's return value)."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "local_models gathers every replica to the host; in a "
+                "multi-process run replicas live on other hosts — use "
+                "center_model/averaged_model (replicated results) instead")
         local_np = jax.tree.map(np.asarray, state.local)
         models = []
         for i in range(self.num_replicas):
@@ -276,6 +343,14 @@ class WindowEngine:
         return models
 
     def averaged_model(self, state: ReplicaState) -> Model:
-        """Arithmetic mean of locals (AveragingTrainer, reference §2.2)."""
-        params = jax.tree.map(lambda a: jnp.mean(jnp.asarray(a), axis=0), state.local)
-        return Model(spec=self.spec, params=params)
+        """Arithmetic mean of locals (AveragingTrainer, reference §2.2).
+
+        The mean runs as a compiled reduction with a REPLICATED output, so
+        it also works when the replicas live on other hosts."""
+        mean_fn = getattr(self, "_mean_fn", None)
+        if mean_fn is None:
+            mean_fn = jax.jit(
+                lambda local: jax.tree.map(lambda a: jnp.mean(a, axis=0), local),
+                out_shardings=NamedSharding(self.mesh, P()))
+            self._mean_fn = mean_fn  # fresh lambdas would defeat the jit cache
+        return Model(spec=self.spec, params=mean_fn(state.local))

@@ -9,8 +9,14 @@ provides the two TPU-native equivalents:
    runtime (coordinator handshake, Gloo/ICI collectives), after which
    ``jax.devices()`` is the global device list and the existing mesh
    trainers work unchanged — collectives ride ICI within a slice and DCN
-   across hosts.  :func:`process_shard` gives each host its slice of the
-   data (the reference's ``df.repartition(num_workers)``).
+   across hosts.  The WindowEngine feeds the mesh with
+   ``make_array_from_process_local_data`` (each process contributes the
+   batch columns its devices own, preserving exact single-process
+   replica-to-rows parity — proven by ``tests/test_multihost.py ::
+   test_two_process_engine_adag_matches_single_process``).
+   :func:`process_shard` gives each host its row-slice of a dataset (the
+   reference's ``df.repartition(num_workers)``) for data planes that
+   cannot hold the full set per host — e.g. async PS workers.
 
 2. **PS multi-host** (async family): :func:`start_parameter_server` runs
    the hub standalone (CLI: ``distkeras-ps``) on a head node; worker hosts
@@ -74,7 +80,12 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
 def process_shard(dataset: Any) -> Any:
     """This host's contiguous shard of the dataset — the multi-host data
     plane (reference: Spark repartition handing each worker one partition).
-    Identity when running single-process."""
+    Identity when running single-process.
+
+    NOTE: the sync WindowEngine does NOT need pre-sharded data — it takes
+    the global chunk on every host and slices each process's batch columns
+    internally (exact single-process parity).  Use this for async PS
+    workers or memory-bound hosts that must not load the full dataset."""
     import jax
 
     n, i = jax.process_count(), jax.process_index()

@@ -409,6 +409,11 @@ class DistributedTrainer(Trainer):
                 "early_stopping monitors validation metrics; pass "
                 "validation_data= (failing now beats training a full epoch "
                 "before the missing metric is noticed)")
+        if checkpointer is not None and jax.process_count() > 1:
+            raise NotImplementedError(
+                "checkpointing a multi-process mesh state is not wired up "
+                "(v1: per-replica leaves live on other hosts); checkpoint "
+                "single-process or snapshot center_model() yourself")
         self._es_best_params = None  # set when early stopping restores best
         engine = self.engine
         state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
@@ -577,6 +582,13 @@ class EnsembleTrainer(DistributedTrainer):
                 "ambiguous for an ensemble (N independent members, no "
                 "single center); evaluate the returned models with "
                 "ModelPredictor/AccuracyEvaluator")
+        if jax.process_count() > 1:
+            # fail BEFORE training: local_models gathers every replica to
+            # the host, which a multi-process mesh cannot do at the end
+            raise NotImplementedError(
+                "EnsembleTrainer returns every replica's weights, which "
+                "live on other hosts in a multi-process run; train "
+                "single-process or use AveragingTrainer (replicated result)")
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer)
         models = self.engine.local_models(state)

@@ -1,0 +1,38 @@
+"""Child process for the 2-host ENGINE test (not collected by pytest).
+
+Joins a 2-process CPU runtime and trains the real sync trainer family
+(ADAG through DistributedTrainer/WindowEngine) on a 4-replica mesh that
+spans the process boundary — the round-2 verdict's gap: the engine had
+only ever run single-process.  Prints per-epoch losses and a digest of the
+trained center so the parent can assert multi-process == single-process.
+
+Usage: python multihost_child_engine.py <process_id> <num_processes> <port>
+"""
+
+import json
+import sys
+
+proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+from distkeras_tpu.runtime.launcher import initialize_multihost  # noqa: E402
+
+initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=nprocs, process_id=proc_id,
+                     cpu_devices_per_process=2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tests.multihost_engine_common import make_toy, run_adag  # noqa: E402
+
+assert jax.process_count() == nprocs
+assert len(jax.devices()) == 2 * nprocs
+
+dataset = make_toy()
+losses, center = run_adag(dataset, num_workers=2 * nprocs)
+print("RESULT " + json.dumps({
+    "process": proc_id,
+    "losses": [round(float(x), 8) for x in losses],
+    "center_sum": float(sum(np.abs(w).sum() for w in center)),
+    "center_digest": [float(np.asarray(w).ravel()[:3].sum()) for w in center],
+}), flush=True)

@@ -1,0 +1,34 @@
+"""Shared fixture for the multi-process engine parity test: the SAME
+deterministic dataset + ADAG training run, imported by both the child
+processes (multi-process mesh) and the parent (single-process reference),
+so any divergence is the engine's, not the harness's."""
+
+import numpy as np
+
+
+def make_toy(n: int = 256, seed: int = 0):
+    from distkeras_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    x = (rng.normal(size=(n, 8)) + 2.5 * y[:, None]).astype(np.float32)
+    return Dataset({"features": x,
+                    "label": np.eye(2, dtype=np.float32)[y],
+                    "label_index": y})
+
+
+def run_adag(dataset, num_workers: int):
+    """Train ADAG deterministically (shuffle off) and return
+    (per-window losses, flattened center weights)."""
+    from distkeras_tpu.models.base import ModelSpec
+    from distkeras_tpu.trainers import ADAG
+    from distkeras_tpu.utils import flatten_weights
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+    trainer = ADAG(spec, loss="categorical_crossentropy", worker_optimizer="sgd",
+                   learning_rate=0.05, num_workers=num_workers, batch_size=8,
+                   num_epoch=3, communication_window=2)
+    model = trainer.train(dataset, shuffle=False)
+    flat, _ = flatten_weights(model.params)
+    return trainer.history, [np.asarray(w) for w in flat]
