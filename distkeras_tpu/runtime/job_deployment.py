@@ -36,9 +36,11 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import json
 import os
 import queue
 import secrets as _secrets
+import shutil
 import socket
 import threading
 import time
@@ -125,6 +127,31 @@ class JobRecord:
             "num_models": len(self.model_blobs),
         }
 
+    def manifest(self) -> Dict[str, Any]:
+        """Everything needed to resurrect this record after a daemon
+        restart EXCEPT bulk payloads (inline data -> data.npz, model blobs
+        -> model_N.bin files beside the manifest)."""
+        return {
+            "job_id": self.job_id,
+            "job": self.job,
+            "state": self.state,
+            "error": self.error,
+            "history": self.history,
+            "training_time": self.training_time,
+            "num_models": len(self.model_blobs),
+            "submitted_at": self.submitted_at,
+        }
+
+    @staticmethod
+    def from_manifest(m: Dict[str, Any]) -> "JobRecord":
+        rec = JobRecord(m["job_id"], m["job"])
+        rec.state = m["state"]
+        rec.error = m.get("error")
+        rec.history = list(m.get("history") or [])
+        rec.training_time = m.get("training_time")
+        rec.submitted_at = m.get("submitted_at", time.time())
+        return rec
+
 
 class Punchcard:
     """The job-deployment daemon (reference: ``Punchcard`` service loop).
@@ -135,16 +162,31 @@ class Punchcard:
     """
 
     def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0,
-                 data_root: Optional[str] = None):
+                 data_root: Optional[str] = None,
+                 state_dir: Optional[str] = None, max_retained: int = 20):
         if not secret:
             raise ValueError("Punchcard requires a non-empty shared secret")
         self._secret = secret
         self._host = host
         self._port = port
         self._data_root = os.path.realpath(data_root) if data_root else None
+        # durability (round-2 weak #6: a restart lost the queue, the running
+        # job, and every fetchable model): job records + payloads spool to
+        # state_dir and the queue reloads on start().  Defaults to
+        # <data_root>/.punchcard-state when a data_root exists; None (no
+        # data_root, no explicit state_dir) stays RAM-only.
+        if state_dir is None and self._data_root is not None:
+            state_dir = os.path.join(self._data_root, ".punchcard-state")
+        self._state_dir = os.path.realpath(state_dir) if state_dir else None
+        self._max_retained = int(max_retained)
         self._jobs: Dict[str, JobRecord] = {}
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         self._lock = threading.Lock()
+        # serializes all spool mutation (handler threads save on cancel
+        # while the executor saves transitions; shared tmp paths must not
+        # interleave) and freezes the spool after stop() so an orphaned
+        # executor can't corrupt state a restarted daemon now owns
+        self._spool_lock = threading.Lock()
         self._running = False
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
@@ -157,11 +199,12 @@ class Punchcard:
         return self._sock.getsockname()[1]
 
     def start(self) -> "Punchcard":
+        self._running = True  # before reload: its saves must not be frozen
+        self._reload_state()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self._host, self._port))
         self._sock.listen(16)
-        self._running = True
         for target in (self._accept_loop, self._executor_loop):
             th = threading.Thread(target=target, daemon=True)
             th.start()
@@ -169,7 +212,7 @@ class Punchcard:
         return self
 
     def stop(self) -> None:
-        self._running = False
+        self._running = False  # also freezes the spool (see _save_record)
         self._queue.put(None)  # wake the executor
         if self._sock is not None:
             try:
@@ -252,6 +295,7 @@ class Punchcard:
             with self._lock:
                 if rec.state == QUEUED:
                     rec.state = CANCELLED
+            self._save_record(rec)
             net.send_json(conn, {"ok": True, "state": rec.state})
         elif action == "fetch":
             rec = self._get(req["job_id"])
@@ -275,6 +319,130 @@ class Punchcard:
             if job_id not in self._jobs:
                 raise KeyError(f"unknown job_id {job_id!r}")
             return self._jobs[job_id]
+
+    # -- durable state ---------------------------------------------------------
+    def _job_dir(self, job_id: str) -> str:
+        assert self._state_dir is not None
+        return os.path.join(self._state_dir, "jobs", job_id)
+
+    def _save_record(self, rec: JobRecord, with_payloads: bool = False) -> None:
+        """Persist the manifest (and optionally inline data / model blobs)
+        atomically: tmp file + rename, so a crash mid-write leaves either
+        the old or the new manifest, never a torn one.  All spool mutation
+        serializes on ``_spool_lock`` and freezes once ``stop()`` ran — an
+        orphaned executor thread must not overwrite state a restarted
+        daemon may already own."""
+        if self._state_dir is None:
+            return
+        with self._spool_lock:
+            if not self._running:
+                return
+            d = self._job_dir(rec.job_id)
+            os.makedirs(d, exist_ok=True)
+            if with_payloads and rec.data is not None:
+                # hand-rolled npz (zip of .npy members): np.savez(**cols)
+                # would collide with its own 'file' parameter for a column
+                # literally named "file"
+                import io
+                import zipfile
+
+                tmp = os.path.join(d, ".data.npz.tmp")
+                with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED) as zf:
+                    for k, v in rec.data.items():
+                        buf = io.BytesIO()
+                        np.save(buf, np.asarray(v))
+                        zf.writestr(f"{k}.npy", buf.getvalue())
+                os.replace(tmp, os.path.join(d, "data.npz"))
+            if with_payloads:
+                for i, blob in enumerate(rec.model_blobs):
+                    tmp = os.path.join(d, f".model_{i}.bin.tmp")
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                    os.replace(tmp, os.path.join(d, f"model_{i}.bin"))
+            tmp = os.path.join(d, ".manifest.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(rec.manifest(), f)
+            os.replace(tmp, os.path.join(d, "manifest.json"))
+
+    def _drop_spooled_data(self, rec: JobRecord) -> None:
+        if self._state_dir is None:
+            return
+        with self._spool_lock:
+            if not self._running:
+                return
+            path = os.path.join(self._job_dir(rec.job_id), "data.npz")
+            if os.path.exists(path):
+                os.remove(path)
+
+    def _evict_old(self) -> None:
+        """Cap disk/RAM retention: beyond ``max_retained`` terminal jobs,
+        the oldest are dropped entirely (records and spool dirs)."""
+        with self._lock:
+            terminal = sorted(
+                (r for r in self._jobs.values()
+                 if r.state in (DONE, FAILED, CANCELLED)),
+                key=lambda r: r.submitted_at)
+            victims = terminal[:max(0, len(terminal) - self._max_retained)]
+            for rec in victims:
+                del self._jobs[rec.job_id]
+        if self._state_dir is not None:
+            with self._spool_lock:
+                if not self._running:
+                    return
+                for rec in victims:
+                    shutil.rmtree(self._job_dir(rec.job_id), ignore_errors=True)
+
+    def _reload_state(self) -> None:
+        """Resurrect spooled jobs: terminal records become fetchable again
+        (model blobs read back), queued AND interrupted-running jobs are
+        re-queued in original submission order."""
+        if self._state_dir is None:
+            return
+        jobs_root = os.path.join(self._state_dir, "jobs")
+        os.makedirs(jobs_root, exist_ok=True)
+        recs = []
+        for job_id in os.listdir(jobs_root):
+            d = os.path.join(jobs_root, job_id)
+            try:
+                with open(os.path.join(d, "manifest.json")) as f:
+                    m = json.load(f)
+                rec = JobRecord.from_manifest(m)
+                num_models = int(m.get("num_models") or 0)
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn/foreign dir: skip, don't brick the daemon
+            if rec.state == DONE:
+                try:
+                    blobs = []
+                    for i in range(num_models):
+                        with open(os.path.join(d, f"model_{i}.bin"), "rb") as f:
+                            blobs.append(f.read())
+                    rec.model_blobs = blobs
+                except OSError:
+                    rec.state = FAILED
+                    rec.error = "daemon restart: model blobs missing from spool"
+            elif rec.state in (QUEUED, RUNNING):
+                if rec.state == RUNNING:
+                    # the interrupted run never completed; start over
+                    rec.state = QUEUED
+                data_path = os.path.join(d, "data.npz")
+                if os.path.exists(data_path):
+                    with np.load(data_path) as npz:
+                        rec.data = {k: npz[k] for k in npz.files}
+                elif "columns" in (rec.job.get("dataset") or {}):
+                    rec.state = FAILED
+                    rec.error = "daemon restart: inline dataset missing from spool"
+            recs.append(rec)
+        recs.sort(key=lambda r: r.submitted_at)
+        with self._lock:
+            for rec in recs:
+                self._jobs[rec.job_id] = rec
+        for rec in recs:
+            if rec.state == QUEUED:
+                self._save_record(rec)  # persist the RUNNING->QUEUED reset
+                self._queue.put(rec.job_id)
+        # an operator may restart with a LOWER --max-retained over a large
+        # spool; trim immediately rather than on the next completed job
+        self._evict_old()
 
     def _submit(self, conn: socket.socket, req: Dict[str, Any]) -> JobRecord:
         job = req["job"]
@@ -310,6 +478,7 @@ class Punchcard:
             raise ValueError("job.dataset needs either 'columns' (inline) or 'path'")
         with self._lock:
             self._jobs[rec.job_id] = rec
+        self._save_record(rec, with_payloads=True)
         self._queue.put(rec.job_id)
         return rec
 
@@ -319,6 +488,13 @@ class Punchcard:
         full = os.path.realpath(os.path.join(self._data_root, path))
         if not (full == self._data_root or full.startswith(self._data_root + os.sep)):
             raise ValueError(f"dataset path {path!r} escapes the data root")
+        if self._state_dir is not None and (
+                full == self._state_dir
+                or full.startswith(self._state_dir + os.sep)):
+            # the spool holds OTHER submitters' inline datasets and models
+            # (and eviction may delete files mid-run); it is not servable
+            raise ValueError(f"dataset path {path!r} points into the daemon's "
+                             "state spool")
         if not os.path.exists(full):
             raise FileNotFoundError(f"dataset path {path!r} not found under data root")
         return full
@@ -329,12 +505,15 @@ class Punchcard:
             job_id = self._queue.get()
             if job_id is None or not self._running:
                 return  # stop() must not let queued jobs keep the devices
-            rec = self._jobs[job_id]
+            rec = self._jobs.get(job_id)
+            if rec is None:
+                continue  # evicted while queued (restart + cap)
             try:
                 with self._lock:
                     if rec.state != QUEUED:
                         continue  # cancelled while queued (finally still runs)
                     rec.state = RUNNING
+                self._save_record(rec)
                 self._run(rec)
                 rec.state = DONE
             except Exception as e:
@@ -343,8 +522,11 @@ class Punchcard:
             finally:
                 # a long-running daemon must not pin submitted datasets in
                 # RAM — cancelled ones included; only the fetchable model
-                # blobs outlive the run
+                # blobs outlive the run (and the spooled data.npz goes too)
                 rec.data = None
+                self._save_record(rec, with_payloads=True)
+                self._drop_spooled_data(rec)
+                self._evict_old()
 
     def _run(self, rec: JobRecord) -> None:
         from distkeras_tpu.data.dataset import Dataset
@@ -548,11 +730,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="file whose (stripped) contents are the shared secret")
     parser.add_argument("--data-root", default=None,
                         help="directory server-side dataset paths are confined to")
+    parser.add_argument("--state-dir", default=None,
+                        help="spool job records/models here so the queue and "
+                             "fetchable results survive a restart (default: "
+                             "<data-root>/.punchcard-state when --data-root is set)")
+    parser.add_argument("--max-retained", type=int, default=20,
+                        help="terminal jobs kept (records + model blobs); older evicted")
     args = parser.parse_args(argv)
     with open(args.secret_file) as f:
         secret = f.read().strip()
     pc = Punchcard(secret=secret, host=args.host, port=args.port,
-                   data_root=args.data_root).start()
+                   data_root=args.data_root, state_dir=args.state_dir,
+                   max_retained=args.max_retained).start()
     print(f"punchcard listening on {args.host}:{pc.port}", flush=True)
     try:
         while True:
