@@ -127,7 +127,7 @@ class WindowEngine:
         # therefore checkpoints — identical either way)
         self.needs_rng = spec.needs_rng
         self._apply = spec.train_apply_fn() if self.needs_rng else spec.apply_fn()
-        self._epoch_fn = self._build_epoch_fn()
+        self._epoch_fns: Dict[int, Callable] = {1: self._build_epoch_fn()}
 
     # -- state ----------------------------------------------------------------
     def _state_specs(self) -> ReplicaState:
@@ -204,7 +204,12 @@ class WindowEngine:
         )
 
     # -- compiled epoch --------------------------------------------------------
-    def _build_epoch_fn(self) -> Callable:
+    def _build_epoch_fn(self, reps: int = 1) -> Callable:
+        """``reps > 1`` compiles ``reps`` passes over the same data into
+        ONE program (outer lax.scan) — the steady-state measurement shape:
+        per-dispatch host/relay overhead amortizes across every epoch
+        instead of dominating each one (the round-2 baseline matrix
+        measured ~100ms relay RPCs, not the chip)."""
         algo = self.algorithm
         axis = self.axis_name
         needs_rng = self.needs_rng
@@ -239,11 +244,19 @@ class WindowEngine:
                 mean_loss = lax.pmean(jnp.mean(losses), axis)
                 return (center, local, opt_state, extra), mean_loss
 
-            (center, local, opt_state, extra), window_losses = lax.scan(
-                window_step, (center, local, opt_state, extra),
-                (xs, ys, keys) if needs_rng else (xs, ys)
-            )
-            num_steps = xs.shape[0] * xs.shape[1]
+            data = (xs, ys, keys) if needs_rng else (xs, ys)
+            if reps == 1:
+                (center, local, opt_state, extra), window_losses = lax.scan(
+                    window_step, (center, local, opt_state, extra), data)
+            else:
+                def one_pass(carry, _):
+                    carry, losses = lax.scan(window_step, carry, data)
+                    return carry, losses
+
+                (center, local, opt_state, extra), window_losses = lax.scan(
+                    one_pass, (center, local, opt_state, extra), None, length=reps)
+                window_losses = window_losses[-1]  # last pass's per-window losses
+            num_steps = xs.shape[0] * xs.shape[1] * reps
             new_state = ReplicaState(
                 center=center,
                 local=jax.tree.map(lambda a: a[None], local),
@@ -266,6 +279,38 @@ class WindowEngine:
     def data_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(None, None, self.axis_name))
 
+    def steady_state_rate(self, state: ReplicaState, xs: np.ndarray, ys: np.ndarray,
+                          reps: int = 4, repeat: int = 3) -> float:
+        """Measured samples/sec/chip with ``reps`` epochs over ``xs``/``ys``
+        inside ONE compiled program — the number that reflects the chip,
+        not the per-dispatch relay overhead.  The engine's training state
+        is copied per run (the epoch program donates its input), so the
+        caller's ``state`` stays usable.  Median of ``repeat`` runs."""
+        import time as _time
+
+        self.spec.reject_rng_spec("steady_state_rate")
+        fn = self._epoch_fns.get(reps)
+        if fn is None:
+            fn = self._build_epoch_fn(reps)
+            self._epoch_fns[reps] = fn
+        xs_d, ys_d = self._place_data(xs, ys)  # multi-process safe
+        keys = jnp.zeros(xs.shape[:2] + (2,), np.uint32)
+        samples = reps * xs.shape[0] * xs.shape[1] * xs.shape[2]
+
+        def fresh():
+            return jax.tree.map(jnp.array, state)
+
+        _, losses = fn(fresh(), xs_d, ys_d, keys)
+        np.asarray(losses)  # compile + completion barrier (relayed platforms)
+        rates = []
+        for _ in range(repeat):
+            s = fresh()
+            t0 = _time.perf_counter()
+            _, losses = fn(s, xs_d, ys_d, keys)
+            np.asarray(losses)
+            rates.append(samples / (_time.perf_counter() - t0))
+        return sorted(rates)[len(rates) // 2] / self.num_replicas
+
     def run_epoch(self, state: ReplicaState, xs: np.ndarray, ys: np.ndarray,
                   keys: Optional[np.ndarray] = None):
         """xs/ys: [num_windows, window, global_batch, ...] host arrays;
@@ -274,18 +319,7 @@ class WindowEngine:
 
         Returns (new_state, per-window mean losses as numpy).
         """
-        sharding = self.data_sharding()
-        if jax.process_count() > 1:
-            # every process passes the same GLOBAL chunk; this process
-            # contributes the batch columns its devices own (exact parity
-            # with the single-process replica->rows assignment, which a
-            # contiguous dataset-level shard would not give)
-            lo, hi = self._local_batch_range(xs.shape[2])
-            xs_d = jax.make_array_from_process_local_data(sharding, xs[:, :, lo:hi])
-            ys_d = jax.make_array_from_process_local_data(sharding, ys[:, :, lo:hi])
-        else:
-            xs_d = jax.device_put(xs, sharding)
-            ys_d = jax.device_put(ys, sharding)
+        xs_d, ys_d = self._place_data(xs, ys)
         if keys is None:
             # any constant is a valid (unused) threefry key when the spec
             # has no rng need; a real run with needs_rng must pass keys
@@ -299,8 +333,21 @@ class WindowEngine:
             keys_d = jax.make_array_from_process_local_data(keys_sh, keys)
         else:
             keys_d = jnp.asarray(keys)
-        state, losses = self._epoch_fn(state, xs_d, ys_d, keys_d)
+        state, losses = self._epoch_fns[1](state, xs_d, ys_d, keys_d)
         return state, np.asarray(losses)
+
+    def _place_data(self, xs, ys):
+        """Host chunk -> mesh-sharded device arrays; in a multi-process
+        run every process passes the same GLOBAL chunk and contributes the
+        batch columns its devices own (exact parity with the
+        single-process replica->rows assignment, which a contiguous
+        dataset-level shard would not give)."""
+        sharding = self.data_sharding()
+        if jax.process_count() > 1:
+            lo, hi = self._local_batch_range(xs.shape[2])
+            return (jax.make_array_from_process_local_data(sharding, xs[:, :, lo:hi]),
+                    jax.make_array_from_process_local_data(sharding, ys[:, :, lo:hi]))
+        return jax.device_put(xs, sharding), jax.device_put(ys, sharding)
 
     def _local_batch_range(self, global_batch: int):
         """Global-batch column range owned by this process's devices (the

@@ -199,20 +199,72 @@ class Punchcard:
         return self._sock.getsockname()[1]
 
     def start(self) -> "Punchcard":
-        self._running = True  # before reload: its saves must not be frozen
-        self._reload_state()
+        # bind FIRST: a second daemon pointed at a live daemon's port must
+        # die on EADDRINUSE before it can touch (and corrupt) the spool
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self._host, self._port))
         self._sock.listen(16)
+        self._acquire_spool_lock()
+        self._running = True  # before reload: its saves must not be frozen
+        self._reload_state()
         for target in (self._accept_loop, self._executor_loop):
             th = threading.Thread(target=target, daemon=True)
             th.start()
             self._threads.append(th)
         return self
 
+    def _acquire_spool_lock(self) -> None:
+        """Exclusive spool ownership: two daemons sharing a state_dir would
+        double-run each other's jobs and rmtree records the other serves.
+        The lock is a pidfile; a stale lock (holder dead, e.g. SIGKILL) is
+        taken over, so crashes never brick restarts."""
+        if self._state_dir is None:
+            return
+        os.makedirs(self._state_dir, exist_ok=True)
+        path = os.path.join(self._state_dir, "daemon.lock")
+        while True:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                self._lock_path = path
+                return
+            except FileExistsError:
+                try:
+                    with open(path) as f:
+                        holder = int(f.read().strip() or "0")
+                except (OSError, ValueError):
+                    holder = 0
+                alive = False
+                if holder == os.getpid():
+                    alive = True  # a second daemon in THIS process is still
+                    #               a second daemon — reject it too
+                elif holder > 0:
+                    try:
+                        os.kill(holder, 0)
+                        alive = True
+                    except (ProcessLookupError, PermissionError):
+                        alive = False
+                if alive:
+                    raise RuntimeError(
+                        f"state_dir {self._state_dir!r} is owned by a live "
+                        f"Punchcard daemon (pid {holder}); two daemons must "
+                        "not share a spool") from None
+                try:
+                    os.remove(path)  # stale: holder is gone, take over
+                except FileNotFoundError:
+                    pass
+
     def stop(self) -> None:
         self._running = False  # also freezes the spool (see _save_record)
+        lock = getattr(self, "_lock_path", None)
+        if lock is not None:
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
+            self._lock_path = None
         self._queue.put(None)  # wake the executor
         if self._sock is not None:
             try:
@@ -420,17 +472,24 @@ class Punchcard:
                 except OSError:
                     rec.state = FAILED
                     rec.error = "daemon restart: model blobs missing from spool"
+                    self._save_record(rec)  # memory and spool must agree
             elif rec.state in (QUEUED, RUNNING):
                 if rec.state == RUNNING:
                     # the interrupted run never completed; start over
                     rec.state = QUEUED
                 data_path = os.path.join(d, "data.npz")
                 if os.path.exists(data_path):
-                    with np.load(data_path) as npz:
-                        rec.data = {k: npz[k] for k in npz.files}
+                    try:
+                        with np.load(data_path) as npz:
+                            rec.data = {k: npz[k] for k in npz.files}
+                    except Exception:  # torn/foreign npz: fail the JOB, not boot
+                        rec.state = FAILED
+                        rec.error = "daemon restart: spooled dataset unreadable"
+                        self._save_record(rec)
                 elif "columns" in (rec.job.get("dataset") or {}):
                     rec.state = FAILED
                     rec.error = "daemon restart: inline dataset missing from spool"
+                    self._save_record(rec)
             recs.append(rec)
         recs.sort(key=lambda r: r.submitted_at)
         with self._lock:
@@ -522,11 +581,19 @@ class Punchcard:
             finally:
                 # a long-running daemon must not pin submitted datasets in
                 # RAM — cancelled ones included; only the fetchable model
-                # blobs outlive the run (and the spooled data.npz goes too)
+                # blobs outlive the run (and the spooled data.npz goes too).
+                # Spool-write failures (ENOSPC, permissions) must NOT kill
+                # the executor thread — durability degrades, execution lives
                 rec.data = None
-                self._save_record(rec, with_payloads=True)
-                self._drop_spooled_data(rec)
-                self._evict_old()
+                try:
+                    self._save_record(rec, with_payloads=True)
+                    self._drop_spooled_data(rec)
+                    self._evict_old()
+                except Exception as e:
+                    rec.error = ((rec.error + "; ") if rec.error else "") +                         f"spool write failed: {type(e).__name__}: {e}"
+                    import sys as _sys
+                    print(f"punchcard: spool write failed for {rec.job_id}: {e}",
+                          file=_sys.stderr, flush=True)
 
     def _run(self, rec: JobRecord) -> None:
         from distkeras_tpu.data.dataset import Dataset

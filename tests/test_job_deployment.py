@@ -337,3 +337,31 @@ def test_inline_column_named_file_survives_spool(tmp_path):
         assert j.wait(timeout=120)["state"] == DONE
     finally:
         pc.stop()
+
+
+def test_higgs_workflow_example_runs_end_to_end():
+    """The ATLAS-Higgs-analogue walkthrough (SURVEY §2.21): transformers ->
+    3 trainers -> predictor -> all 4 evaluators -> checkpoint-resume ->
+    Punchcard deploy, top to bottom on the CPU mesh."""
+    from distkeras_tpu.examples.higgs_workflow import main
+
+    main(["--rows", "1536", "--epochs", "4", "--workers", "4"])
+
+
+def test_spool_lock_rejects_second_daemon_same_state_dir(tmp_path):
+    """Two daemons must not share a spool even on different ports; stale
+    locks from a dead holder are taken over."""
+    import os as _os
+
+    pc = Punchcard(secret=SECRET, data_root=str(tmp_path)).start()
+    try:
+        with pytest.raises(RuntimeError, match="owned by a live"):
+            Punchcard(secret=SECRET, data_root=str(tmp_path)).start()
+    finally:
+        pc.stop()
+    # stale lock (fake dead pid) is taken over transparently
+    lock = _os.path.join(str(tmp_path), ".punchcard-state", "daemon.lock")
+    with open(lock, "w") as f:
+        f.write("999999999")
+    pc2 = Punchcard(secret=SECRET, data_root=str(tmp_path)).start()
+    pc2.stop()

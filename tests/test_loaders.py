@@ -33,12 +33,16 @@ def test_synthetic_is_deterministic_and_learnable():
     a, _, _ = load_mnist()
     b, _, _ = load_mnist()
     np.testing.assert_array_equal(a["features"][:16], b["features"][:16])
-    # nearest-prototype separability: a linear probe must beat chance easily
-    x = a["features"][:2000].reshape(2000, -1)
-    y = a["label_index"][:2000]
+    # nearest-class-mean separability on the TRAINING means: must clearly
+    # beat chance (the signal is real) but stay well below ceiling (the
+    # round-3 hardening intentionally makes one-shot separation impossible
+    # so wall-to-target measures training, not compile time)
+    x = a["features"][:4000].reshape(4000, -1)
+    y = a["label_index"][:4000]
     centers = np.stack([x[y == c].mean(axis=0) for c in range(10)])
     pred = np.argmin(((x[:, None, :] - centers[None]) ** 2).sum(-1), axis=1)
-    assert (pred == y).mean() > 0.9
+    acc = (pred == y).mean()
+    assert 0.2 < acc < 0.995, acc
 
 
 def test_real_npz_cache_wins(tmp_path):
@@ -113,3 +117,98 @@ def test_chunked_training_matches_unchunked():
         for a, b in zip(np.asarray(list(m_full.params.values())[0]["kernel"]).ravel(),
                         np.asarray(list(m_chunk.params.values())[0]["kernel"]).ravel()):
             assert a == pytest.approx(b, rel=1e-5)
+
+
+def test_raw_idx_mnist_files_load(tmp_path):
+    """The four raw (gzipped) IDX files work as dropped in — no npz
+    conversion step."""
+    import gzip
+    import struct
+
+    rng = np.random.default_rng(0)
+
+    def write_idx(name, arr):
+        arr = np.asarray(arr, np.uint8)
+        magic = 0x0800 | arr.ndim
+        payload = struct.pack(">I", magic) + b"".join(
+            struct.pack(">I", d) for d in arr.shape) + arr.tobytes()
+        with gzip.open(tmp_path / (name + ".gz"), "wb") as f:
+            f.write(payload)
+
+    write_idx("train-images-idx3-ubyte", rng.integers(0, 256, (32, 28, 28)))
+    write_idx("train-labels-idx1-ubyte", rng.integers(0, 10, (32,)))
+    write_idx("t10k-images-idx3-ubyte", rng.integers(0, 256, (8, 28, 28)))
+    write_idx("t10k-labels-idx1-ubyte", rng.integers(0, 10, (8,)))
+
+    train, test, info = load_mnist(cache_dir=str(tmp_path), synthetic_fallback=False)
+    assert not info["synthetic"]
+    assert train["features"].shape == (32, 28, 28, 1)
+    assert test["features"].shape == (8, 28, 28, 1)
+    assert train["label"].shape == (32, 10)
+
+
+def test_raw_cifar_pickle_batches_load(tmp_path):
+    """The upstream pickled cifar-10-batches-py directory works as
+    extracted — no conversion step."""
+    import pickle
+
+    rng = np.random.default_rng(1)
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    for i in range(1, 6):
+        batch = {b"data": rng.integers(0, 256, (4, 3072), dtype=np.uint8),
+                 b"labels": rng.integers(0, 10, 4).tolist()}
+        (d / f"data_batch_{i}").write_bytes(pickle.dumps(batch))
+    test_batch = {b"data": rng.integers(0, 256, (6, 3072), dtype=np.uint8),
+                  b"labels": rng.integers(0, 10, 6).tolist()}
+    (d / "test_batch").write_bytes(pickle.dumps(test_batch))
+
+    from distkeras_tpu.data.loaders import load_cifar10
+
+    train, test, info = load_cifar10(cache_dir=str(tmp_path), synthetic_fallback=False)
+    assert not info["synthetic"]
+    assert train["features"].shape == (20, 32, 32, 3)
+    assert test["features"].shape == (6, 32, 32, 3)
+
+
+def test_raw_cifar_targz_loads(tmp_path):
+    """The literal downloaded cifar-100-python.tar.gz works unextracted."""
+    import io
+    import pickle
+    import tarfile
+
+    rng = np.random.default_rng(2)
+
+    def member(labels_key, n):
+        return pickle.dumps({b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                             labels_key: rng.integers(0, 100, n).tolist()})
+
+    tar_path = tmp_path / "cifar-100-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, blob in (("train", member(b"fine_labels", 10)),
+                           ("test", member(b"fine_labels", 4))):
+            ti = tarfile.TarInfo(f"cifar-100-python/{name}")
+            ti.size = len(blob)
+            tf.addfile(ti, io.BytesIO(blob))
+
+    from distkeras_tpu.data.loaders import load_cifar100
+
+    train, test, info = load_cifar100(cache_dir=str(tmp_path), synthetic_fallback=False)
+    assert not info["synthetic"]
+    assert train["features"].shape == (10, 32, 32, 3)
+    assert test["features"].shape == (4, 32, 32, 3)
+
+
+def test_synthetic_has_label_noise_and_overlap():
+    """The stand-ins must be HARD: train labels carry noise (test clean),
+    and per-pixel class signal is small against the pixel noise, so
+    targets take real training instead of measuring compile time."""
+    train, test, info = load_mnist(cache_dir="/nonexistent-xyz")
+    assert info["synthetic"]
+    x = train["features"].reshape(len(train), -1)
+    y = train["label_index"]
+    # per-pixel SNR: class-delta std is far below the noise std
+    class_means = np.stack([x[y == c].mean(0) for c in range(10)])
+    signal = class_means.std(0).mean()
+    noise = np.mean([x[y == c].std(0).mean() for c in range(10)])
+    assert signal < 0.35 * noise, (signal, noise)
