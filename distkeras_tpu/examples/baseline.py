@@ -98,7 +98,7 @@ def _steady_rate(trainer, train_ds, reps: int = 3, max_windows: int = 64) -> flo
 
 
 def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
-               synthetic_target: float = 0.95) -> Dict[str, Any]:
+               synthetic_target: Optional[float] = None) -> Dict[str, Any]:
     """Train one BASELINE config to its accuracy target (or the epoch cap);
     returns the metric record."""
     import jax
@@ -109,22 +109,30 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
     from distkeras_tpu.models.mlp import mnist_mlp_spec
     from distkeras_tpu.models.resnet import resnet20_spec
 
-    # (name, trainer class, trainer kwargs, spec, loader, real-data target)
+    # (name, trainer class, trainer kwargs, spec, loader,
+    #  real-data target, synthetic target).  Synthetic targets are
+    # calibrated per shape on v5e (2026-07-30) so every config needs
+    # multiple epochs of REAL training: the 32x32x3 CNNs find the smooth
+    # class signal much faster than the 28x28 models (0.98 after epoch 1,
+    # so their bar is 0.99), and 100-way classification plateaus near 0.73
+    # on this generator (bar 0.70, crossed around epoch 9-11).
     configs = {
         1: ("SingleTrainer MLP/MNIST", SingleTrainer, {},
-            mnist_mlp_spec(), lambda: load_mnist(flatten=True), 0.97),
+            mnist_mlp_spec(), lambda: load_mnist(flatten=True), 0.97, 0.95),
         2: ("ADAG CNN/MNIST", ADAG, {"communication_window": 4},
-            mnist_cnn_spec(), lambda: load_mnist(), 0.99),
+            mnist_cnn_spec(), lambda: load_mnist(), 0.99, 0.95),
         3: ("AEASGD CNN/CIFAR-10", AEASGD, {"communication_window": 8, "rho": 1.0},
-            cifar_cnn_spec(), lambda: load_cifar10(), 0.70),
+            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.99),
         4: ("DOWNPOUR CNN/CIFAR-10", DOWNPOUR, {"communication_window": 4},
-            cifar_cnn_spec(), lambda: load_cifar10(), 0.70),
+            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.99),
         5: ("DynSGD ResNet-20/CIFAR-100", DynSGD, {"communication_window": 4},
-            resnet20_spec(num_outputs=100), lambda: load_cifar100(), 0.40),
+            resnet20_spec(num_outputs=100), lambda: load_cifar100(), 0.40, 0.70),
     }
-    name, cls, kwargs, spec, loader, real_target = configs[num]
+    name, cls, kwargs, spec, loader, real_target, synth_target = configs[num]
     train_ds, test_ds, info = loader()
-    target = synthetic_target if info["synthetic"] else real_target
+    if synthetic_target is not None:
+        synth_target = synthetic_target
+    target = synth_target if info["synthetic"] else real_target
     bs = batch_size or (64 if num >= 3 else 128)
     lr = 0.05 if num != 5 else 0.02
 
@@ -187,7 +195,9 @@ def main(argv=None) -> None:
                         help="1-5 or 'all'")
     parser.add_argument("--cpu", type=int, default=0,
                         help="simulate this many CPU devices instead of real chips")
-    parser.add_argument("--epochs-cap", type=int, default=10)
+    # default cap sized for the HARDEST config on the round-3 synthetics
+    # (config 5 crosses its 0.70 bar around epoch 14)
+    parser.add_argument("--epochs-cap", type=int, default=18)
     parser.add_argument("--batch-size", type=int, default=None)
     parser.add_argument("--out", default=None, help="write records to this JSON file")
     args = parser.parse_args(argv)

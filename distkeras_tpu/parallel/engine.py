@@ -294,7 +294,7 @@ class WindowEngine:
             fn = self._build_epoch_fn(reps)
             self._epoch_fns[reps] = fn
         xs_d, ys_d = self._place_data(xs, ys)  # multi-process safe
-        keys = jnp.zeros(xs.shape[:2] + (2,), np.uint32)
+        keys = self._place_keys(np.zeros(xs.shape[:2] + (2,), np.uint32))
         samples = reps * xs.shape[0] * xs.shape[1] * xs.shape[2]
 
         def fresh():
@@ -327,14 +327,17 @@ class WindowEngine:
                 raise ValueError("this engine's spec needs per-batch dropout "
                                  "keys; pass keys=[num_windows, window, 2]")
             keys = np.zeros(xs.shape[:2] + (2,), np.uint32)
-        keys = np.asarray(keys)
-        if jax.process_count() > 1:
-            keys_sh = NamedSharding(self.mesh, P())
-            keys_d = jax.make_array_from_process_local_data(keys_sh, keys)
-        else:
-            keys_d = jnp.asarray(keys)
+        keys_d = self._place_keys(np.asarray(keys))
         state, losses = self._epoch_fns[1](state, xs_d, ys_d, keys_d)
         return state, np.asarray(losses)
+
+    def _place_keys(self, keys: np.ndarray):
+        """Replicated placement for the per-batch key stream — a
+        process-local array cannot enter a program spanning processes."""
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P()), keys)
+        return jnp.asarray(keys)
 
     def _place_data(self, xs, ys):
         """Host chunk -> mesh-sharded device arrays; in a multi-process

@@ -205,7 +205,12 @@ class Punchcard:
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self._host, self._port))
         self._sock.listen(16)
-        self._acquire_spool_lock()
+        try:
+            self._acquire_spool_lock()
+        except BaseException:
+            self._sock.close()  # a failed start must not leak the bound port
+            self._sock = None
+            raise
         self._running = True  # before reload: its saves must not be frozen
         self._reload_state()
         for target in (self._accept_loop, self._executor_loop):
@@ -244,8 +249,11 @@ class Punchcard:
                     try:
                         os.kill(holder, 0)
                         alive = True
-                    except (ProcessLookupError, PermissionError):
+                    except ProcessLookupError:
                         alive = False
+                    except PermissionError:
+                        alive = True  # EPERM means the pid EXISTS (another
+                        #               user's daemon) — standard pidfile idiom
                 if alive:
                     raise RuntimeError(
                         f"state_dir {self._state_dir!r} is owned by a live "

@@ -199,11 +199,16 @@ def test_raw_cifar_targz_loads(tmp_path):
     assert test["features"].shape == (4, 32, 32, 3)
 
 
-def test_synthetic_has_label_noise_and_overlap():
+def test_synthetic_has_label_noise_and_overlap(tmp_path, monkeypatch):
     """The stand-ins must be HARD: train labels carry noise (test clean),
     and per-pixel class signal is small against the pixel noise, so
     targets take real training instead of measuring compile time."""
-    train, test, info = load_mnist(cache_dir="/nonexistent-xyz")
+    # isolate from the machine's real caches (~/.keras etc.): a dev box
+    # with a cached mnist.npz must not turn this into a real-data test
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.delenv("DKT_DATA_DIR", raising=False)
+    monkeypatch.chdir(tmp_path)
+    train, test, info = load_mnist(cache_dir=str(tmp_path))
     assert info["synthetic"]
     x = train["features"].reshape(len(train), -1)
     y = train["label_index"]

@@ -312,3 +312,24 @@ def test_async_elastic_rejects_schedule_learning_rate():
         with pytest.raises(ValueError, match="scalar learning_rate"):
             cls(tiny_mlp_spec(), loss="categorical_crossentropy",
                 num_workers=2, learning_rate=sched)
+
+
+def test_engine_steady_state_rate_preserves_state(toy_dataset):
+    """steady_state_rate compiles a multi-epoch program, reports a positive
+    rate, and must NOT consume the caller's state (the epoch program
+    donates its inputs; the method copies internally)."""
+    trainer = ADAG(tiny_mlp_spec(), loss="categorical_crossentropy",
+                   worker_optimizer="sgd", learning_rate=0.05,
+                   num_workers=8, batch_size=8, num_epoch=1,
+                   communication_window=2)
+    trainer.train(toy_dataset)
+    engine = trainer.engine
+    state = engine.init_state(trainer.model)
+    chunk = next(iter(toy_dataset.chunked_epoch(
+        64, ["features", "label"], window=2, chunk_windows=2)))
+    rate = engine.steady_state_rate(state, chunk["features"], chunk["label"],
+                                    reps=2, repeat=2)
+    assert rate > 0
+    # the caller's state is still alive and usable afterwards
+    state2, losses = engine.run_epoch(state, chunk["features"], chunk["label"])
+    assert np.isfinite(losses).all()
