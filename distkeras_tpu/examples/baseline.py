@@ -115,7 +115,8 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
     # multiple epochs of REAL training: the 32x32x3 CNNs find the smooth
     # class signal much faster than the 28x28 models (0.98 after epoch 1,
     # so their bar is 0.99), and 100-way classification plateaus near 0.73
-    # on this generator (bar 0.70, crossed around epoch 9-11).
+    # on this generator (bar 0.70, first crossed at epoch 14 in the
+    # recorded v5e run — see BASELINE_RESULTS.json).
     configs = {
         1: ("SingleTrainer MLP/MNIST", SingleTrainer, {},
             mnist_mlp_spec(), lambda: load_mnist(flatten=True), 0.97, 0.95),

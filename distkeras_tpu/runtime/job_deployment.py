@@ -207,12 +207,21 @@ class Punchcard:
         self._sock.listen(16)
         try:
             self._acquire_spool_lock()
+            self._running = True  # before reload: its saves must not be frozen
+            self._reload_state()
         except BaseException:
-            self._sock.close()  # a failed start must not leak the bound port
+            # a failed start must leak neither the bound port nor the lock
+            self._running = False
+            self._sock.close()
             self._sock = None
+            lock = getattr(self, "_lock_path", None)
+            if lock is not None:
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
+                self._lock_path = None
             raise
-        self._running = True  # before reload: its saves must not be frozen
-        self._reload_state()
         for target in (self._accept_loop, self._executor_loop):
             th = threading.Thread(target=target, daemon=True)
             th.start()

@@ -30,9 +30,34 @@ assert len(jax.devices()) == 2 * nprocs
 
 dataset = make_toy()
 losses, center = run_adag(dataset, num_workers=2 * nprocs)
+
+# the OTHER multi-process engine paths: averaged_model's compiled
+# cross-host mean reduction, and the in-program steady-state measurement
+from distkeras_tpu.models.base import ModelSpec  # noqa: E402
+from distkeras_tpu.trainers import AveragingTrainer  # noqa: E402
+
+spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                 input_shape=(8,))
+avg_trainer = AveragingTrainer(spec, loss="categorical_crossentropy",
+                               worker_optimizer="sgd", learning_rate=0.05,
+                               num_workers=2 * nprocs, batch_size=8,
+                               num_epoch=2)
+avg_model = avg_trainer.train(dataset, shuffle=False)
+avg_sum = float(sum(np.abs(np.asarray(w)).sum()
+                    for w in jax.tree.leaves(avg_model.params)))
+
+engine = avg_trainer.engine
+chunk = next(iter(dataset.chunked_epoch(8 * 2 * nprocs, ["features", "label"],
+                                        window=1, chunk_windows=4)))
+rate = engine.steady_state_rate(engine.init_state(avg_model),
+                                chunk["features"], chunk["label"],
+                                reps=2, repeat=1)
+
 print("RESULT " + json.dumps({
     "process": proc_id,
     "losses": [round(float(x), 8) for x in losses],
     "center_sum": float(sum(np.abs(w).sum() for w in center)),
     "center_digest": [float(np.asarray(w).ravel()[:3].sum()) for w in center],
+    "avg_sum": round(avg_sum, 6),
+    "steady_rate_positive": bool(rate > 0),
 }), flush=True)

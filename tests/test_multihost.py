@@ -150,6 +150,11 @@ def test_two_process_engine_adag_matches_single_process():
     assert results[0]["losses"] == results[1]["losses"]
     np.testing.assert_allclose(results[0]["center_digest"],
                                results[1]["center_digest"], rtol=1e-6)
+    # AveragingTrainer's compiled cross-host mean + the in-program
+    # steady-state measurement both crossed the process boundary too
+    np.testing.assert_allclose(results[0]["avg_sum"], results[1]["avg_sum"],
+                               rtol=1e-6)
+    assert results[0]["steady_rate_positive"] and results[1]["steady_rate_positive"]
 
     # single-process 4-replica reference on the same data
     from tests.multihost_engine_common import make_toy, run_adag
