@@ -26,7 +26,10 @@ in, no conversion step:
 - the upstream ``cifar-10-batches-py``/``cifar-100-python`` directories or
   their ``.tar.gz`` archives (the canonical pickled python batches — these
   are the one place the no-pickle rule yields, because the upstream
-  distribution IS a pickle; only load archives you put there yourself).
+  distribution IS a pickle).  Pickled archives are ONLY loaded from dirs
+  you designated explicitly — the ``cache_dir`` argument or
+  ``$DKT_DATA_DIR`` — never from the shared search dirs (cwd ``./data``,
+  ``~/.keras/datasets``), so nothing an attacker drops there is unpickled.
 """
 
 from __future__ import annotations
@@ -43,17 +46,26 @@ import numpy as np
 from distkeras_tpu.data.dataset import Dataset
 
 
-def _search_dirs(cache_dir: Optional[str]):
+def _trusted_dirs(cache_dir: Optional[str]):
+    """Dirs the user designated EXPLICITLY (a ``cache_dir`` argument or
+    ``$DKT_DATA_DIR``).  Formats whose parsing executes a pickle are only
+    ever loaded from here — never from the shared/implicit search dirs —
+    so an attacker-placed archive in cwd or ``~/.keras`` cannot reach
+    ``pickle.loads`` (the module's no-pickle rule, see module docstring)."""
     dirs = []
     if cache_dir:
         dirs.append(cache_dir)
     if os.environ.get("DKT_DATA_DIR"):
         dirs.append(os.environ["DKT_DATA_DIR"])
-    home = os.path.expanduser("~")
-    dirs += [os.path.join(home, ".keras", "datasets"),
-             os.path.join(home, ".cache", "distkeras_tpu"),
-             os.path.join(os.getcwd(), "data")]
     return dirs
+
+
+def _search_dirs(cache_dir: Optional[str]):
+    home = os.path.expanduser("~")
+    return _trusted_dirs(cache_dir) + [
+        os.path.join(home, ".keras", "datasets"),
+        os.path.join(home, ".cache", "distkeras_tpu"),
+        os.path.join(os.getcwd(), "data")]
 
 
 def _find_npz(filename: str, cache_dir: Optional[str]) -> Optional[str]:
@@ -135,7 +147,8 @@ def _find_cifar_raw(kind: str, cache_dir: Optional[str]):
         with open(path, "rb") as f:
             return f.read()
 
-    for d in _search_dirs(cache_dir):
+    trusted = _trusted_dirs(cache_dir)
+    for d in trusted:
         root = os.path.join(d, kind)
         if os.path.isdir(root):
             try:
@@ -157,6 +170,25 @@ def _find_cifar_raw(kind: str, cache_dir: Optional[str]):
                 return (tr["x"], tr["y"], te["x"], te["y"]), tar_path
             except (OSError, KeyError, tarfile.TarError, pickle.UnpicklingError):
                 continue
+    # existence-only scan (nothing is unpickled) of the SHARED dirs so a
+    # user whose archive sits in ~/.keras/datasets learns why it was
+    # skipped instead of silently training on synthetics
+    import warnings
+
+    for d in _search_dirs(cache_dir):
+        if d in trusted:
+            continue
+        for name in (kind, kind.replace("-batches-py", "-python") + ".tar.gz"):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                warnings.warn(
+                    f"found raw CIFAR archive {p!r} but pickled archives are "
+                    f"only loaded from explicitly designated dirs (the "
+                    f"cache_dir argument or $DKT_DATA_DIR); move the archive "
+                    f"to a directory YOU control and designate that — do not "
+                    f"designate shared/world-writable dirs, unpickling an "
+                    f"attacker-placed archive executes code", stacklevel=3)
+                break
     return None, None
 
 
@@ -246,13 +278,15 @@ def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
         xtr, ytr, xte, yte = _synthetic_images(
             num_classes, image_shape, *synthetic_sizes, seed=seed)
         info = {"synthetic": True,
-                "source": f"deterministic synthetic stand-in (no {filename} or "
-                          f"raw archive in {_search_dirs(cache_dir)})"}
+                "source": f"deterministic synthetic stand-in (no {filename} in "
+                          f"{_search_dirs(cache_dir)}; raw pickled archives are "
+                          f"honored only in {_trusted_dirs(cache_dir) or 'cache_dir/$DKT_DATA_DIR'})"}
     else:
         raise FileNotFoundError(
-            f"{filename} (or the raw distribution archive) not found in "
-            f"{_search_dirs(cache_dir)} and synthetic_fallback=False "
-            "(this environment has no network access)")
+            f"{filename} not found in {_search_dirs(cache_dir)} (raw pickled "
+            f"archives are honored only in explicitly designated dirs: "
+            f"{_trusted_dirs(cache_dir) or 'pass cache_dir= or set $DKT_DATA_DIR'}) "
+            "and synthetic_fallback=False (this environment has no network access)")
     train, test = _to_datasets(xtr, ytr, xte, yte, num_classes, flatten)
     info.update(num_classes=num_classes, train_rows=len(train), test_rows=len(test))
     return train, test, info

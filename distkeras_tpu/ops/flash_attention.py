@@ -252,7 +252,6 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """
     kj, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
-    nk = pl.num_programs(2)
     bq, bk = cfg.block_q_bwd, cfg.block_k_bwd
 
     @pl.when(qi == 0)
@@ -282,12 +281,15 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
-    # dq row block qi receives its final contribution on the last kj pass;
-    # earlier passes emit stale blocks that the final, ordered revisit of
-    # the same HBM region overwrites
-    @pl.when(kj == nk - 1)
-    def _flush_q():
-        dq_ref[0, 0] = (dq_scr[pl.ds(qi * bq, bq), :] * scale).astype(dq_ref.dtype)
+    # dq row block qi accumulates across the OUTER kj steps, so its output
+    # window is revisited once per kj.  Emit the current accumulated prefix
+    # on EVERY visit: each window Pallas flushes then holds kernel-written
+    # data and the final, ordered revisit carries the complete sum —
+    # correctness rests on last-write-wins, not on revisited output
+    # windows preserving stale buffer contents (unstated semantics under
+    # double-buffering).  The extra [bq, d] VMEM store per step is noise
+    # next to the three matmuls above.
+    dq_ref[0, 0] = (dq_scr[pl.ds(qi * bq, bq), :] * scale).astype(dq_ref.dtype)
 
 
 def _out_struct(shape, dtype, *like):

@@ -214,13 +214,7 @@ class Punchcard:
             self._running = False
             self._sock.close()
             self._sock = None
-            lock = getattr(self, "_lock_path", None)
-            if lock is not None:
-                try:
-                    os.remove(lock)
-                except OSError:
-                    pass
-                self._lock_path = None
+            self._release_spool_lock()
             raise
         for target in (self._accept_loop, self._executor_loop):
             th = threading.Thread(target=target, daemon=True)
@@ -237,59 +231,109 @@ class Punchcard:
             return
         os.makedirs(self._state_dir, exist_ok=True)
         path = os.path.join(self._state_dir, "daemon.lock")
-        while True:
-            try:
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.write(fd, str(os.getpid()).encode())
-                os.close(fd)
-                self._lock_path = path
-                return
-            except FileExistsError:
+        # the whole check-remove-create sequence holds an flock on a guard
+        # file: without it two daemons racing a stale lock can BOTH read the
+        # dead pid, and the slower one's os.remove() deletes the faster
+        # one's freshly created pidfile (TOCTOU) — then both own the spool
+        import fcntl
+
+        try:
+            # 0o666 (pre-umask) so another user of a SHARED state_dir can
+            # still open the guard after this process dies — a 0600 guard
+            # would permanently block the cross-user stale-lock takeover
+            # the pidfile's EPERM handling explicitly supports
+            guard = os.open(os.path.join(self._state_dir, ".lock-guard"),
+                            os.O_CREAT | os.O_RDWR, 0o666)
+        except PermissionError:
+            # a prior owner created the guard with a restrictive umask and
+            # we can't open it: degrade to unguarded acquisition (the
+            # O_EXCL pidfile still provides mutual exclusion; only the
+            # stale-takeover race window reopens) rather than bricking
+            # every other user's restart forever
+            guard = None
+        try:
+            if guard is not None:
+                fcntl.flock(guard, fcntl.LOCK_EX)
+            while True:
                 try:
-                    with open(path) as f:
-                        holder = int(f.read().strip() or "0")
-                except (OSError, ValueError):
-                    holder = 0
-                alive = False
-                if holder == os.getpid():
-                    alive = True  # a second daemon in THIS process is still
-                    #               a second daemon — reject it too
-                elif holder > 0:
+                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.write(fd, str(os.getpid()).encode())
+                    os.close(fd)
+                    self._lock_path = path
+                    return
+                except FileExistsError:
                     try:
-                        os.kill(holder, 0)
-                        alive = True
-                    except ProcessLookupError:
-                        alive = False
-                    except PermissionError:
-                        alive = True  # EPERM means the pid EXISTS (another
-                        #               user's daemon) — standard pidfile idiom
-                if alive:
-                    raise RuntimeError(
-                        f"state_dir {self._state_dir!r} is owned by a live "
-                        f"Punchcard daemon (pid {holder}); two daemons must "
-                        "not share a spool") from None
+                        with open(path) as f:
+                            holder = int(f.read().strip() or "0")
+                    except (OSError, ValueError):
+                        holder = 0
+                    alive = False
+                    if holder == os.getpid():
+                        alive = True  # a second daemon in THIS process is still
+                        #               a second daemon — reject it too
+                    elif holder > 0:
+                        try:
+                            os.kill(holder, 0)
+                            alive = True
+                        except ProcessLookupError:
+                            alive = False
+                        except PermissionError:
+                            alive = True  # EPERM means the pid EXISTS (another
+                            #               user's daemon) — standard pidfile idiom
+                    if alive:
+                        raise RuntimeError(
+                            f"state_dir {self._state_dir!r} is owned by a live "
+                            f"Punchcard daemon (pid {holder}); two daemons must "
+                            "not share a spool") from None
+                    try:
+                        os.remove(path)  # stale: holder is gone, take over
+                    except FileNotFoundError:
+                        pass
+        finally:
+            if guard is not None:
                 try:
-                    os.remove(path)  # stale: holder is gone, take over
-                except FileNotFoundError:
-                    pass
+                    fcntl.flock(guard, fcntl.LOCK_UN)
+                finally:
+                    os.close(guard)
 
     def stop(self) -> None:
         self._running = False  # also freezes the spool (see _save_record)
-        lock = getattr(self, "_lock_path", None)
-        if lock is not None:
-            try:
-                os.remove(lock)
-            except OSError:
-                pass
-            self._lock_path = None
         self._queue.put(None)  # wake the executor
         if self._sock is not None:
+            # close() alone does NOT wake a concurrently-blocked accept()
+            # on Linux; shutdown() makes it return EINVAL immediately, which
+            # the join below needs now that lock release waits on the threads
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
                 pass
         for th in self._threads:
             th.join(timeout=5)
+        # release the pidfile only AFTER the executor thread confirmed exit:
+        # dropping it while a job is still running would let a restarted
+        # daemon requeue the spooled RUNNING record and execute it a second
+        # time, concurrently, on the same devices.  If the join timed out the
+        # lock stays for now (this pid is alive, so a takeover is correctly
+        # refused) and the executor itself releases it when the job finally
+        # ends (_executor_loop's exit path) — otherwise nothing ever would.
+        if not any(th.is_alive() for th in self._threads):
+            self._release_spool_lock()
+
+    def _release_spool_lock(self) -> None:
+        """Idempotent pidfile release; callable from stop() AND from the
+        executor's own exit path (they may race after a timed-out join)."""
+        with self._lock:
+            lock = getattr(self, "_lock_path", None)
+            self._lock_path = None
+        if lock is not None:
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
 
     # -- accept/handle ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -580,7 +624,13 @@ class Punchcard:
         while True:
             job_id = self._queue.get()
             if job_id is None or not self._running:
-                return  # stop() must not let queued jobs keep the devices
+                # stop() must not let queued jobs keep the devices.  If
+                # stop()'s join timed out because a job outlived it, stop()
+                # left the pidfile for us — release it now that no job can
+                # ever run again, or restarts in this process would be
+                # refused forever ("owned by a live daemon", our own pid)
+                self._release_spool_lock()
+                return
             rec = self._jobs.get(job_id)
             if rec is None:
                 continue  # evicted while queued (restart + cap)
