@@ -244,6 +244,14 @@ class Punchcard:
             # the pidfile's EPERM handling explicitly supports
             guard = os.open(os.path.join(self._state_dir, ".lock-guard"),
                             os.O_CREAT | os.O_RDWR, 0o666)
+            try:
+                # os.open's mode is masked by umask (022 → 0644), which
+                # would deny other users the O_RDWR open and silently
+                # reopen the TOCTOU this guard closes; fchmod realizes the
+                # intended world-RW bits (best-effort: may not own the file)
+                os.fchmod(guard, 0o666)
+            except OSError:
+                pass
         except PermissionError:
             # a prior owner created the guard with a restrictive umask and
             # we can't open it: degrade to unguarded acquisition (the

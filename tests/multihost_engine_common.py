@@ -19,16 +19,54 @@ def make_toy(n: int = 256, seed: int = 0):
 
 def run_adag(dataset, num_workers: int):
     """Train ADAG deterministically (shuffle off) and return
-    (per-window losses, flattened center weights)."""
+    (per-window losses, flattened center weights) — thin wrapper so the
+    ADAG parity test and the elastic-family test share ONE hyperparameter
+    set (a drifted copy would make them assert different configs)."""
+    losses, center, _ = run_engine("adag", dataset, num_workers)
+    return losses, center
+
+
+def run_engine(kind: str, dataset, num_workers: int):
+    """Train one sync trainer deterministically KEEPING the final engine
+    state, so per-replica artifacts can be asserted across a process
+    boundary.  Returns (per-window losses, flat center weights,
+    per-replica local-weight L1 norms [R]).
+
+    The norms come from a compiled reduction with a REPLICATED output
+    (like ``WindowEngine.averaged_model``), so they are identical on every
+    process even though the locals themselves live on different hosts —
+    exactly the artifact that proves AEASGD's divergent locals and
+    DynSGD's rank-scaled commits survived the process boundary.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distkeras_tpu import trainers
     from distkeras_tpu.models.base import ModelSpec
-    from distkeras_tpu.trainers import ADAG
     from distkeras_tpu.utils import flatten_weights
 
     spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
                      input_shape=(8,))
-    trainer = ADAG(spec, loss="categorical_crossentropy", worker_optimizer="sgd",
-                   learning_rate=0.05, num_workers=num_workers, batch_size=8,
-                   num_epoch=3, communication_window=2)
-    model = trainer.train(dataset, shuffle=False)
-    flat, _ = flatten_weights(model.params)
-    return trainer.history, [np.asarray(w) for w in flat]
+    cls = {"adag": trainers.ADAG, "aeasgd": trainers.AEASGD,
+           "dynsgd": trainers.DynSGD}[kind]
+    kwargs = dict(loss="categorical_crossentropy", worker_optimizer="sgd",
+                  learning_rate=0.05, num_workers=num_workers, batch_size=8,
+                  num_epoch=3, communication_window=2)
+    if kind == "aeasgd":
+        kwargs["rho"] = 1.0
+    trainer = cls(spec, **kwargs)
+    trainer.record_training_start()
+    state = trainer._run_epochs(dataset, shuffle=False)
+    center = trainer.engine.center_model(state).params
+    flat, _ = flatten_weights(center)
+
+    def replica_norms(local):
+        per_leaf = [jnp.abs(a).reshape(a.shape[0], -1).sum(axis=1)
+                    for a in jax.tree.leaves(local)]
+        return jnp.stack(per_leaf).sum(axis=0)
+
+    norms = jax.jit(replica_norms,
+                    out_shardings=NamedSharding(trainer.engine.mesh, P()))(state.local)
+    return (trainer.history, [np.asarray(w) for w in flat],
+            [float(x) for x in np.asarray(norms)])

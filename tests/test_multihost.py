@@ -165,3 +165,54 @@ def test_two_process_engine_adag_matches_single_process():
     np.testing.assert_allclose(
         results[0]["center_sum"],
         float(sum(np.abs(w).sum() for w in center_ref)), rtol=1e-5)
+
+
+def test_two_process_engine_elastic_family_matches_single_process():
+    """Round-3 weak #5 closed: the elastic family's distinctive state
+    crosses a real process boundary.  AEASGD keeps per-replica DIVERGENT
+    local weights (SURVEY §7 "hard parts" memory layout — replicas 0/1
+    live on process 0, replicas 2/3 on process 1) and DynSGD scales each
+    replica's commit by its rank; both must reproduce the single-process
+    4-replica run exactly: same per-window losses, same center, and the
+    SAME per-replica local-norm vector."""
+    import json
+
+    port = _free_port()
+    cmds = [[sys.executable, os.path.join(_TESTS_DIR, "multihost_child_elastic.py"),
+             str(i), "2", str(port)] for i in range(2)]
+    outs = _run_children(cmds)
+
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"child output missing RESULT line:\n{out}"
+        results.append(json.loads(lines[0][len("RESULT "):]))
+
+    from tests.multihost_engine_common import make_toy, run_engine
+
+    for kind in ("aeasgd", "dynsgd"):
+        a, b = results[0][kind], results[1][kind]
+        # both processes observe one global mesh program
+        assert a["losses"] == b["losses"], kind
+        np.testing.assert_allclose(a["center_digest"], b["center_digest"],
+                                   rtol=1e-6, err_msg=kind)
+        np.testing.assert_allclose(a["local_norms"], b["local_norms"],
+                                   rtol=1e-6, err_msg=kind)
+
+        # single-process 4-replica reference on the same data
+        losses_ref, center_ref, norms_ref = run_engine(kind, make_toy(),
+                                                       num_workers=4)
+        np.testing.assert_allclose(a["losses"], losses_ref, rtol=1e-5,
+                                   atol=1e-7, err_msg=kind)
+        np.testing.assert_allclose(
+            a["center_sum"], float(sum(np.abs(w).sum() for w in center_ref)),
+            rtol=1e-5, err_msg=kind)
+        np.testing.assert_allclose(a["local_norms"], norms_ref, rtol=1e-4,
+                                   err_msg=kind)
+
+    # AEASGD's locals must actually have DIVERGED (each replica trained a
+    # different data shard and the elastic pull keeps them distinct);
+    # DynSGD resets locals to the center every window, so no such claim
+    aeasgd_norms = results[0]["aeasgd"]["local_norms"]
+    assert len(set(aeasgd_norms)) == len(aeasgd_norms), \
+        f"AEASGD locals did not diverge: {aeasgd_norms}"
