@@ -166,15 +166,15 @@ def main(argv=None) -> None:
     with tempfile.TemporaryDirectory() as sroot:
         pc = Punchcard(secret="higgs-demo", data_root=sroot).start()
         try:
-            job_trainer = best_name if best_name != "aeasgd" else "adag"
+            # the daemon is the cluster head (SURVEY §2.18): it owns the
+            # devices, so the job it executes is the flagship DISTRIBUTED
+            # trainer — ADAG trains on the daemon's whole mesh and the
+            # client fetches the center model back over the wire
             job_kwargs = {k: v for k, v in common.items()
                           if k not in ("features_col", "label_col")}
-            if job_trainer == "single":
-                job_kwargs["batch_size"] = 64
-            else:
-                job_kwargs.update(dist)
+            job_kwargs.update(dist)
             job = Job("127.0.0.1", pc.port, "higgs-demo", name="higgs",
-                      model=spec, trainer=job_trainer,
+                      model=spec, trainer="adag",
                       trainer_kwargs=job_kwargs,
                       data=Dataset({"features": train["features"],
                                     "label": train["label_onehot"]}))

@@ -57,15 +57,26 @@ def test_submit_run_fetch_roundtrip(punchcard):
 
 
 def test_distributed_trainer_job(punchcard):
-    feats, onehot, _ = _toy_data(n=512)
+    """The daemon executes the flagship DISTRIBUTED trainer on a
+    multi-replica CPU mesh (round-3 verdict task 6): the submitted ADAG
+    config names an explicit 4-replica mesh, the job trains across it
+    inside the daemon process, and the fetched center model has actually
+    learned — not just produced the right shapes."""
+    feats, onehot, labels = _toy_data(n=512)
     ds = Dataset({"features": feats, "label": onehot})
     job = Job("127.0.0.1", punchcard.port, SECRET, name="adag-job",
               model=_spec(), trainer="adag",
-              trainer_kwargs={"num_epoch": 3, "batch_size": 16,
+              trainer_kwargs={"num_epoch": 10, "batch_size": 16,
+                              "num_workers": 4, "learning_rate": 0.1,
                               "communication_window": 2},
               data=ds)
     model = job.run(timeout=240)
-    assert model.predict(feats).shape == (512, 4)
+    st = job.status()
+    assert st["state"] == DONE
+    # the daemon-side trainer really ran a multi-window distributed loop
+    assert len(st["history"]) > 1 and st["history"][-1] < st["history"][0]
+    preds = model.predict(feats).argmax(axis=-1)
+    assert (preds == labels).mean() > 0.8, "center model did not learn"
 
 
 def test_npz_path_dataset(punchcard, tmp_path):

@@ -212,7 +212,13 @@ def test_two_process_engine_elastic_family_matches_single_process():
 
     # AEASGD's locals must actually have DIVERGED (each replica trained a
     # different data shard and the elastic pull keeps them distinct);
-    # DynSGD resets locals to the center every window, so no such claim
+    # DynSGD resets locals to the center every window, so no such claim.
+    # Minimum pairwise norm gap, not exact distinctness: the measured gap
+    # on this config is ~0.025, so 1e-3 has 25x margin while staying far
+    # above float/rounding noise (a coincidental-equal-norms pass is the
+    # only false negative left, and the cross-process parity asserts above
+    # already pin the exact per-replica values)
     aeasgd_norms = results[0]["aeasgd"]["local_norms"]
-    assert len(set(aeasgd_norms)) == len(aeasgd_norms), \
-        f"AEASGD locals did not diverge: {aeasgd_norms}"
+    min_gap = min(abs(a - b) for i, a in enumerate(aeasgd_norms)
+                  for b in aeasgd_norms[i + 1:])
+    assert min_gap > 1e-3, f"AEASGD locals did not diverge: {aeasgd_norms}"
