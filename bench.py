@@ -151,6 +151,13 @@ def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int 
               remat: bool = False):
     """TransformerLM fwd+bwd train step: tokens/sec + MFU (flash attention).
 
+    ``num_heads`` is a real lever, not plumbing: at fixed model_dim the
+    VPU softmax work per score is constant while the per-score matmul
+    FLOPs scale with head_dim, so 4 heads x 128 head_dim halves the
+    attention VPU-to-MXU ratio of 8 x 64 at identical total FLOPs — the
+    round-3 hypothesis for why the 512-dim legs cap near 0.38 MFU while
+    1024-dim (head_dim 128) reaches 0.47.
+
     The loss path is the framework's fused unembed+CE
     (``ops.losses.unembed_cross_entropy``, same as ``make_lm_train_step``):
     the unembed matmul runs in bf16 at MXU rate and the [B, L, V] f32
@@ -282,20 +289,151 @@ def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int =
     }
 
 
-def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256,
+def _device_time_ms(fn, *args, reps: int = 3):
+    """(median ms per call, wall spread, source) for ``fn(*args)`` where
+    ``source`` is ``"device"`` (profiler module events) or ``"wall"`` (the
+    fallback) — callers must surface the source in their methodology tag
+    so a wall fallback can never match a device-keyed baseline.
+
+    Wall-clock on the relayed axon platform carries a ~10-110ms dispatch
+    cost that swings with tenancy — for sub-second programs (every decode
+    leg) that noise DOMINATED the round-3 numbers and fired a false
+    regression tripwire (BENCH_r03 fp 0.78x).  The on-device duration of
+    the program's ``jit_*`` XLA-module event, read from a
+    ``jax.profiler.trace``, is stable to ~0.01% run-to-run (measured
+    2026-07-31: three reps of the decode program within 5us of each
+    other), so per-leg ``vs_baseline`` tripwires key on device time.
+    Falls back to wall time when the trace has no module events (CPU
+    interpret paths in tests)."""
+    import glob
+    import gzip
+    import os as _os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    def once():
+        r = fn(*args)
+        np.asarray(r[0] if isinstance(r, tuple) else r)
+
+    once()  # compile + warm outside the trace
+    walls = []
+    with tempfile.TemporaryDirectory() as td:
+        with jax.profiler.trace(td):
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                once()
+                walls.append(time.perf_counter() - t0)
+        durs = []
+        for tf in glob.glob(_os.path.join(td, "**", "*.trace.json.gz"),
+                            recursive=True):
+            with gzip.open(tf, "rt") as fh:
+                data = json.load(fh)
+            for ev in data.get("traceEvents", []):
+                if ev.get("ph") == "X" and ev.get("name", "").startswith("jit_"):
+                    durs.append(ev["dur"] / 1e3)
+    def median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2
+
+    wall_med = median(walls)
+    spread = round((walls[-1] - walls[0]) / wall_med, 3) if wall_med else 0.0
+    # the timed program is the section's only dispatch, so its reps are the
+    # largest module events in the trace
+    durs = sorted(durs)[-reps:]
+    if len(durs) < reps:
+        # the caller must TAG the number as wall time — a wall number under
+        # a device-keyed baseline would fire the exact false tripwire this
+        # helper exists to kill
+        return wall_med * 1e3, spread, "wall"
+    return median(durs), spread, "device"
+
+
+def _train_decode_pair(spec, draft_spec, vocab: int, *, steps: int = 300,
+                       batch: int = 16, seq: int = 256, seed: int = 0):
+    """Teach the decode target AND draft the same predictable next-token
+    structure so speculative acceptance is realistic (round-3 verdict task
+    1b: a random-weights draft agrees with a random-weights target ~never,
+    which measures nothing).
+
+    The task: tokens follow a fixed random successor map with 10% uniform
+    noise — the optimal greedy predictor is the map itself, learnable by
+    both the 8-layer target and the small draft, so their greedy argmaxes
+    agree wherever both learned the map.  Returns (target_params,
+    draft_params); training runs as one compiled scan per model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.ops.losses import lm_token_cross_entropy
+
+    rng = np.random.default_rng(seed)
+    succ = rng.permutation(vocab)
+    toks = np.empty((steps, batch, seq), np.int64)
+    cur = rng.integers(0, vocab, (steps, batch))
+    for t in range(seq):
+        toks[:, :, t] = cur
+        nxt = succ[cur]
+        noise = rng.random((steps, batch)) < 0.10
+        cur = np.where(noise, rng.integers(0, vocab, (steps, batch)), nxt)
+    tok_d = jnp.asarray(toks, jnp.int32)
+
+    from distkeras_tpu.parallel.lm import shift_targets
+    tgt_d = jnp.asarray(shift_targets(toks).astype(np.int32))
+
+    def fit(spec_, seed_):
+        module = spec_.build()
+        model = Model.init(spec_, seed=seed_)
+        opt = optax.adam(1e-3)
+
+        def loss_fn(params, tok, tgt):
+            return lm_token_cross_entropy(module, params, tok, tgt)[:, :-1].mean()
+
+        @jax.jit
+        def run(params, opt_state):
+            def body(carry, data):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, *data)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (tok_d, tgt_d))
+            return params, losses
+
+        params, losses = run(model.params, opt.init(model.params))
+        np.asarray(losses)
+        return params
+
+    return fit(spec, seed_=0), fit(draft_spec, seed_=1)
+
+
+def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 512,
                   model_dim: int = 512, num_heads: int = 8, num_layers: int = 8,
-                  vocab: int = 8192):
+                  vocab: int = 8192, reps: int = 3, train_steps: int = 300):
     """KV-cache autoregressive decode throughput (greedy), tokens/sec —
-    three modes on the same model family: fp (bf16 activations, f32
-    weights), int8 (weight-only quantized params), and speculative (a
-    2-layer draft proposing k=4 tokens per target verification).
+    fp (bf16 activations, f32 weights), int8 (weight-only quantized
+    params), and speculative (small draft proposing k=4 tokens per target
+    verification, with TRAINED target+draft so acceptance is real).
 
     The whole generation (prefill + ``new_tokens`` scanned single-token
-    steps) is one compiled program, so the relay dispatch cost amortizes
-    over the full sequence.  Speculative runs batch 1 (its decode path is
-    single-sequence); its tokens/sec is NOT comparable to the batched fp
-    number — compare via ``ms_per_token`` against a batch-1 fp run, which
-    is also reported."""
+    steps) is one compiled program.  Round-3 verdict weak #1: min-of-2
+    WALL timing over ~0.1s generations swung ±30-60% with relay tenancy
+    (a fixed ~10-110ms dispatch cost on sub-second programs) and fired a
+    false 0.78x regression tripwire — every leg now reports the ON-DEVICE
+    median (``_device_time_ms``; run-to-run stable to ~0.01%) plus the
+    wall ``spread`` as a tenancy indicator.  Measured decomposition
+    (2026-07-31, fp_b1): 45.5ms device + ~110ms relay in a 156ms wall.
+
+    Speculative runs batch 1 (its decode path is single-sequence); compare
+    it against the fp_b1 leg, never the batched number.  b1 decode at this
+    scale is bound by per-op launch overhead, NOT weight bandwidth
+    (storing weights bf16/int8 moves b1 <3%), which is why the draft's
+    value is cutting sequential target steps, not FLOPs."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -306,55 +444,87 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 25
     from distkeras_tpu.models.transformer import small_lm_spec
     from distkeras_tpu.ops.quantize import quantize_params
 
+    max_len = prompt_len + new_tokens + 16
     spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim, num_heads=num_heads,
-                         num_layers=num_layers, max_seq_len=prompt_len + new_tokens + 8)
+                         num_layers=num_layers, max_seq_len=max_len)
     model = Model.init(spec, seed=0)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)), jnp.int32)
     key = jax.random.PRNGKey(0)
 
-    def timed(fn, *args, reps: int = 2):
-        np.asarray(fn(*args))  # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            np.asarray(fn(*args))
-            best = min(best, time.perf_counter() - t0)
-        return best
+    sources = []
+
+    def leg(timing, n=new_tokens, **extra):
+        ms, spread, source = timing
+        sources.append(source)
+        dt = ms / 1e3
+        return {"tokens_per_sec": round(n / dt, 1),
+                "ms_per_token": round(dt / n * 1e3, 4),
+                "wall_spread": spread, **extra}
 
     out = {"batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens}
 
     fn = make_generate_fn(spec, new_tokens)
-    dt = timed(fn, model.params, prompt, key)
-    out["fp"] = {"tokens_per_sec": round(batch * new_tokens / dt, 1),
-                 "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+    out["fp"] = leg(_device_time_ms(fn, model.params, prompt, key, reps=reps),
+                    n=batch * new_tokens)
 
     qparams = quantize_params(model.params)
-    dt = timed(fn, qparams, prompt, key)
-    out["int8"] = {"tokens_per_sec": round(batch * new_tokens / dt, 1),
-                   "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+    out["int8"] = leg(_device_time_ms(fn, qparams, prompt, key, reps=reps),
+                      n=batch * new_tokens)
 
-    # batch-1 legs: fp reference + speculative (draft = 2-layer same-width)
-    dt = timed(fn, model.params, prompt[:1], key)
-    out["fp_b1"] = {"tokens_per_sec": round(new_tokens / dt, 1),
-                    "ms_per_token": round(dt / new_tokens * 1e3, 3)}
-    draft_spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
-                               num_heads=num_heads, num_layers=2,
-                               max_seq_len=prompt_len + new_tokens + 8)
-    draft = Model.init(draft_spec, seed=1)
-    sfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=4)
-    dt = timed(sfn, model.params, draft.params, prompt[:1])
-    out["speculative_b1"] = {"tokens_per_sec": round(new_tokens / dt, 1),
-                             "ms_per_token": round(dt / new_tokens * 1e3, 3),
-                             "draft_layers": 2, "k": 4}
+    out["fp_b1"] = leg(_device_time_ms(fn, model.params, prompt[:1], key, reps=reps))
+
+    # speculative leg: TRAINED 8-layer target + small draft on a
+    # predictable task (see _train_decode_pair) — acceptance_rate is part
+    # of the leg; a random-weights pair would report ~0 acceptance and the
+    # number would mean nothing.  k=8/draft 2L-128 from the 2026-07-31
+    # device-time sweep: 29.9k tok/s vs fp_b1's 11.2k (2.66x)
+    draft_dim = min(128, model_dim)
+    draft_spec = small_lm_spec(vocab_size=vocab, model_dim=draft_dim,
+                               num_heads=min(2, num_heads), num_layers=2,
+                               max_seq_len=max_len)
+    t_params, d_params = _train_decode_pair(spec, draft_spec, vocab,
+                                            steps=train_steps)
+    k = 8
+    sfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=k,
+                                       with_stats=True)
+    toks, iters = sfn(t_params, d_params, prompt[:1])
+    np.asarray(toks)
+    # the while-loop commits new_tokens - 1 tokens (the first comes from
+    # the prefill, before the loop), m + 1 per round -> mean m =
+    # (n-1)/iters - 1.  The final round may be truncated by the n bound,
+    # so clamp to [0, 1] rather than report a boundary artifact
+    acceptance = ((new_tokens - 1) / max(int(iters), 1) - 1.0) / k
+    acceptance = min(max(acceptance, 0.0), 1.0)
+    out["speculative_b1"] = leg(
+        _device_time_ms(sfn, t_params, d_params, prompt[:1], reps=reps),
+        draft_layers=2, draft_dim=draft_dim, k=k,
+        acceptance_rate=round(float(acceptance), 3), trained=True)
+    # the same trained target through the PLAIN decode path: the apples-to-
+    # apples denominator for the speculative speedup claim (weights don't
+    # change plain-decode cost, but report it measured, not assumed)
+    out["fp_b1_trained"] = leg(_device_time_ms(fn, t_params, prompt[:1], key,
+                                               reps=reps))
+    spec_ratio = (out["speculative_b1"]["tokens_per_sec"]
+                  / out["fp_b1_trained"]["tokens_per_sec"])
+    out["speculative_speedup_vs_fp_b1"] = round(spec_ratio, 3)
+    # one wall fallback anywhere taints the whole section's tag: a wall
+    # number under a device-keyed baseline is the false-tripwire class
+    # this methodology change exists to kill
+    source = "device" if all(s == "device" for s in sources) else "wall"
+    out["timing"] = f"{source}-median-of-{reps}"
     return out
 
 
-# (seq_len, batch, model_dim, num_layers, steps) for the LM train legs.
-# The 1024-dim/16-layer leg exists to show WHERE MFU saturates: the
-# 512-dim legs are attention-VPU-bound at head_dim 64, the 1024-dim model
-# (head_dim 128) has 4x the matmul work per attention score.  steps are
-# sized so the ~100ms relay dispatch stays ~1-2% of the reported step.
+# (seq_len, batch, model_dim, num_layers, num_heads, steps) for the LM
+# train legs.  The 1024-dim/16-layer leg exists to show WHERE MFU
+# saturates: the 512-dim legs are attention-VPU-bound at head_dim 64, the
+# 1024-dim model (head_dim 128) has 4x the matmul work per attention
+# score.  The 4-head/512-dim leg is the controlled test of that
+# hypothesis (round-3 verdict task 2): head_dim 128 at IDENTICAL FLOPs to
+# the 8-head leg — if the diagnosis is right its MFU jumps toward the
+# 1024-dim number.  steps are sized so the ~100ms relay dispatch stays
+# ~1-2% of the reported step.
 # 32k HBM watch-out: in round 2 a 6-step 32k run inside the full bench
 # (after the earlier legs' HBM pressure) once degraded ~25x to 24s/step;
 # the fused backward's smaller footprint made 8 steps measure sane
@@ -362,11 +532,91 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 25
 # wildly slow step again, suspect HBM pressure from the preceding legs
 # first and drop its step count back down.
 _LM_LEGS = (
-    (2048, 8, 512, 8, 100),
-    (8192, 2, 512, 8, 50),
-    (32768, 1, 512, 8, 8),
-    (2048, 4, 1024, 16, 30),
+    (2048, 8, 512, 8, 8, 100),
+    (8192, 2, 512, 8, 8, 50),
+    (32768, 1, 512, 8, 8, 8),
+    (2048, 4, 1024, 16, 8, 30),
+    (2048, 8, 512, 8, 4, 100),
 )
+
+
+def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
+                head_dim: int = 64, steps: int = 30):
+    """Ring-attention PER-BLOCK compute: flash kernel vs dense XLA on one
+    [B, l_local, H, D] block, fwd+bwd — the measurement behind
+    ``ring_attention``'s auto-select threshold (``ops/attention.py``:
+    flash per-block at l_local >= 2048, dense below).  Round-3 verdict
+    task 4: these crossover numbers lived only in a docstring with no
+    tripwire; now they are bench legs with ``vs_baseline``, so threshold
+    drift after a kernel change trips visibly.
+
+    The timed work mirrors one LIVE ring step: block attention WITH the
+    logsumexp output (the ring merge needs it) and full gradients.
+    Times are ON-DEVICE (``_device_time_ms``): at these ~3-10ms/step
+    scales a wall reading would carry ~30-100% relay-dispatch noise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from distkeras_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.default_rng(0)
+    shape = (batch, l_local, heads, head_dim)
+    q, k, v = (jnp.asarray(rng.normal(size=shape) * 0.1, dtype=jnp.bfloat16)
+               for _ in range(3))
+
+    def dense_with_lse(q, k, v, causal=True):
+        # the dense branch of ring_attention.block_attn: f32 scores, (o, lse)
+        scale = 1.0 / jnp.sqrt(jnp.float32(head_dim))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        if causal:
+            pos = jnp.arange(l_local)
+            logits = jnp.where((pos[:, None] >= pos[None, :])[None, None],
+                               logits, -jnp.inf)
+        m = jnp.max(logits, axis=-1)
+        p = jnp.exp(logits - m[..., None])
+        l_sum = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return (o / l_sum.transpose(0, 2, 1)[..., None]).astype(q.dtype), \
+            m + jnp.log(l_sum)
+
+    def timed(fn):
+        def loss(q, k, v):
+            o, lse = fn(q, k, v, causal=True)
+            # both outputs live (the ring merge differentiates through lse)
+            return jnp.sum(o.astype(jnp.float32)) + 1e-3 * jnp.sum(lse)
+
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def run(q, k, v):
+            def body(q, _):
+                gq, gk, gv = grad_fn(q, k, v)
+                return q + 1e-6 * gq, (jnp.sum(gk) + jnp.sum(gv)).astype(jnp.float32)
+
+            q, sums = lax.scan(body, q, None, length=steps)
+            return sums
+
+        ms, _, source = _device_time_ms(run, q, k, v, reps=2)
+        return ms / steps, source
+
+    from distkeras_tpu.ops.attention import ring_block_impl
+
+    flash_ms, f_src = timed(flash_attention_with_lse)
+    dense_ms, d_src = timed(dense_with_lse)
+    return {
+        "l_local": l_local,
+        "flash_ms": round(flash_ms, 3),
+        "dense_ms": round(dense_ms, 3),
+        "flash_speedup": round(dense_ms / flash_ms, 2),
+        "timing": ("device" if f_src == d_src == "device" else "wall"),
+        # what ring_attention actually auto-selects for this shard length
+        # (shared predicate — restating the threshold here would hide the
+        # drift this leg exists to catch)
+        "auto_selects": ring_block_impl(l_local),
+    }
 
 
 def _leg_ratio(current: float, base: float):
@@ -382,7 +632,8 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     visibly.  Legs are matched by config key; a methodology or config
     change simply finds no match and reports no ratio."""
     for leg in out.get("lm", ()):
-        key = f"lm:{leg.get('seq_len')}x{leg.get('batch')}:d{leg.get('model_dim', 512)}"
+        key = (f"lm:{leg.get('seq_len')}x{leg.get('batch')}"
+               f":d{leg.get('model_dim', 512)}h{leg.get('num_heads', 8)}")
         base = baseline.get("legs", {}).get(key, {})
         r = _leg_ratio(leg.get("tokens_per_sec"), base.get("tokens_per_sec"))
         if r is not None:
@@ -394,10 +645,22 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
         r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
         if r is not None:
             leg["vs_baseline"] = r
+    for leg in out.get("ring", ()):
+        if leg.get("timing") != "device":
+            continue  # wall fallback must not ratio against device records
+        key = f"ring:{leg.get('l_local')}"
+        base = baseline.get("legs", {}).get(key, {})
+        r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
+        if r is not None:
+            leg["vs_baseline"] = r
     dec = out.get("decode", {})
-    for mode in ("fp", "int8", "fp_b1", "speculative_b1"):
+    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "speculative_b1"):
         sub = dec.get(mode)
-        base = baseline.get("legs", {}).get(f"decode:{mode}", {})
+        # methodology-coded key: generation length and timing stat are part
+        # of the identity, so the round-3 min-of-2-wall/256-token records
+        # can never produce a ratio against a device-median/512-token run
+        key = f"decode:{mode}:n{dec.get('new_tokens')}:{dec.get('timing')}"
+        base = baseline.get("legs", {}).get(key, {})
         if isinstance(sub, dict):
             r = _leg_ratio(sub.get("tokens_per_sec"), base.get("tokens_per_sec"))
             if r is not None:
@@ -456,16 +719,18 @@ def main() -> None:
             # gc between legs drops dead device buffers promptly: HBM
             # pressure from earlier legs once blew the 32k LM leg up 25x
             gc.collect()
-            lm, attn = [], []
-            for seq, batch, model_dim, num_layers, steps in _LM_LEGS:
+            lm, attn, ring = [], [], []
+            for seq, batch, model_dim, num_layers, num_heads, steps in _LM_LEGS:
                 try:
                     leg = _bench_lm(seq, batch, model_dim=model_dim,
-                                    num_heads=8, num_layers=num_layers,
+                                    num_heads=num_heads, num_layers=num_layers,
                                     steps=steps)
                     leg["model_dim"] = model_dim
+                    leg["num_heads"] = num_heads
                     lm.append(leg)
                 except Exception as e:
                     lm.append({"seq_len": seq, "model_dim": model_dim,
+                               "num_heads": num_heads,
                                "error": f"{type(e).__name__}: {e}"})
                 gc.collect()
             for seq, steps in ((2048, 50), (8192, 25)):
@@ -474,8 +739,16 @@ def main() -> None:
                 except Exception as e:
                     attn.append({"seq_len": seq, "error": f"{type(e).__name__}: {e}"})
                 gc.collect()
+            for l_local in (1024, 2048, 4096):
+                try:
+                    ring.append(_bench_ring(l_local))
+                except Exception as e:
+                    ring.append({"l_local": l_local,
+                                 "error": f"{type(e).__name__}: {e}"})
+                gc.collect()
             out["lm"] = lm
             out["attn"] = attn
+            out["ring"] = ring
             try:
                 out["decode"] = _bench_decode()
             except Exception as e:

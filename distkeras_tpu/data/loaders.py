@@ -193,7 +193,8 @@ def _find_cifar_raw(kind: str, cache_dir: Optional[str]):
 
 
 def _synthetic_images(num_classes: int, shape: Tuple[int, ...], n_train: int,
-                      n_test: int, seed: int, label_noise: float = 0.05):
+                      n_test: int, seed: int, label_noise: float = 0.05,
+                      signal_amplitude: float = 7.0):
     """Hard synthetic stand-ins: same shape/dtype as the real set,
     deterministic, and calibrated so accuracy targets take real training.
 
@@ -221,7 +222,10 @@ def _synthetic_images(num_classes: int, shape: Tuple[int, ...], n_train: int,
             sl = [slice(None)] * deltas.ndim
             sl[axis] = slice(0, shape[axis - 1])
             deltas = deltas[tuple(sl)]
-    deltas *= 7.0 / (deltas.std() + 1e-9)
+    # per-dataset amplitude: the pixel-SNR knob that sets how many epochs
+    # of evidence-averaging a conv net needs (calibration notes on each
+    # loader; lower = harder)
+    deltas *= signal_amplitude / (deltas.std() + 1e-9)
 
     def make(n, split_seed, noisy_labels):
         r = np.random.default_rng(split_seed)
@@ -260,8 +264,8 @@ def _to_datasets(x_train, y_train, x_test, y_test, num_classes: int,
 
 def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
           synthetic_sizes: Tuple[int, int], seed: int, cache_dir: Optional[str],
-          synthetic_fallback: bool, flatten: bool, raw_finder=None
-          ) -> Tuple[Dataset, Dataset, Dict]:
+          synthetic_fallback: bool, flatten: bool, raw_finder=None,
+          signal_amplitude: float = 7.0) -> Tuple[Dataset, Dataset, Dict]:
     path = _find_npz(filename, cache_dir)
     raw = raw_source = None
     if path is None and raw_finder is not None:
@@ -276,7 +280,8 @@ def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
         info = {"synthetic": False, "source": raw_source}
     elif synthetic_fallback:
         xtr, ytr, xte, yte = _synthetic_images(
-            num_classes, image_shape, *synthetic_sizes, seed=seed)
+            num_classes, image_shape, *synthetic_sizes, seed=seed,
+            signal_amplitude=signal_amplitude)
         info = {"synthetic": True,
                 "source": f"deterministic synthetic stand-in (no {filename} in "
                           f"{_search_dirs(cache_dir)}; raw pickled archives are "
@@ -303,10 +308,16 @@ def load_mnist(cache_dir: Optional[str] = None, synthetic_fallback: bool = True,
 
 def load_cifar10(cache_dir: Optional[str] = None, synthetic_fallback: bool = True
                  ) -> Tuple[Dataset, Dataset, Dict]:
-    """CIFAR-10: features [N, 32, 32, 3] float32 in [0,1]."""
+    """CIFAR-10: features [N, 32, 32, 3] float32 in [0,1].
+
+    Synthetic amplitude 3.5 (v5e calibration, 2026-07-31): at the round-3
+    default of 7.0 the 32x32x3 CNN separated the classes in 1-2 epochs
+    (0.986 after epoch 1), defeating the wall-to-target metric.  At 3.5
+    the DOWNPOUR/AEASGD BASELINE configs climb 0.67 -> 0.78 -> 0.88 ->
+    0.89 -> 0.90 -> 0.92 and cross their 0.90 target around epoch 5."""
     return _load("cifar10.npz", 10, (32, 32, 3), (50000, 10000), seed=2345,
                  cache_dir=cache_dir, synthetic_fallback=synthetic_fallback,
-                 flatten=False,
+                 flatten=False, signal_amplitude=3.5,
                  raw_finder=lambda cd: _find_cifar_raw("cifar-10-batches-py", cd))
 
 

@@ -111,21 +111,22 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
 
     # (name, trainer class, trainer kwargs, spec, loader,
     #  real-data target, synthetic target).  Synthetic targets are
-    # calibrated per shape on v5e (2026-07-30) so every config needs
-    # multiple epochs of REAL training: the 32x32x3 CNNs find the smooth
-    # class signal much faster than the 28x28 models (0.98 after epoch 1,
-    # so their bar is 0.99), and 100-way classification plateaus near 0.73
-    # on this generator (bar 0.70, first crossed at epoch 14 in the
-    # recorded v5e run — see BASELINE_RESULTS.json).
+    # calibrated per shape on v5e so every config needs multiple epochs of
+    # REAL training: the CIFAR-10 stand-in runs at signal amplitude 3.5
+    # (2026-07-31 recalibration — at the old 7.0 the CNN configs hit 0.99
+    # in 2 epochs, defeating wall-to-target; at 3.5 / target 0.90 they
+    # cross around epoch 5), and 100-way classification plateaus near
+    # 0.73 on the amplitude-7.0 generator (bar 0.70, first crossed at
+    # epoch 14 in the recorded v5e run — see BASELINE_RESULTS.json).
     configs = {
         1: ("SingleTrainer MLP/MNIST", SingleTrainer, {},
             mnist_mlp_spec(), lambda: load_mnist(flatten=True), 0.97, 0.95),
         2: ("ADAG CNN/MNIST", ADAG, {"communication_window": 4},
             mnist_cnn_spec(), lambda: load_mnist(), 0.99, 0.95),
         3: ("AEASGD CNN/CIFAR-10", AEASGD, {"communication_window": 8, "rho": 1.0},
-            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.99),
+            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.90),
         4: ("DOWNPOUR CNN/CIFAR-10", DOWNPOUR, {"communication_window": 4},
-            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.99),
+            cifar_cnn_spec(), lambda: load_cifar10(), 0.70, 0.90),
         5: ("DynSGD ResNet-20/CIFAR-100", DynSGD, {"communication_window": 4},
             resnet20_spec(num_outputs=100), lambda: load_cifar100(), 0.40, 0.70),
     }
@@ -142,14 +143,20 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
 
     samples_per_epoch = len(train_ds)
     accs: List[float] = []
+    epoch_walls: List[float] = []  # per-epoch train+eval wall (round-3
+    # verdict weak #6: single-shot wall columns on a shared relayed chip
+    # swung 2-8x with tenancy; the per-epoch spread makes the noise visible
+    # and the median gives a de-noised wall estimate)
     t0 = time.perf_counter()
     t_target = None
     for epoch in range(epochs_cap):
         # distinct shuffle order per outer epoch: each train() call runs its
         # internal epoch 0, whose shuffle seed is trainer.seed + 0
         trainer.seed = epoch
+        t_ep = time.perf_counter()
         trainer.train(train_ds, shuffle=True)
         acc = float(_evaluate(trainer.model, test_ds))
+        epoch_walls.append(time.perf_counter() - t_ep)
         accs.append(round(acc, 4))
         if t_target is None and acc >= target:
             t_target = time.perf_counter() - t0
@@ -164,6 +171,19 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
     # = replica count) — NOT jax.device_count()
     n_chips = trainer.metrics[-1]["chips"] if trainer.metrics else jax.device_count()
     epochs_run = len(accs)
+    # the first epoch pays compilation; the median of the REMAINING epochs
+    # is the de-noised per-epoch wall (falls back to all epochs when only
+    # one ran).  spread = (max-min)/median over the same set.  True median
+    # (middle pair averaged): the upper-middle element would hand a 2-epoch
+    # run its WORST epoch — the tenancy spike this column exists to remove.
+    steady_walls = sorted(epoch_walls[1:]) or sorted(epoch_walls)
+    if steady_walls:
+        mid = len(steady_walls) // 2
+        ep_median = (steady_walls[mid] if len(steady_walls) % 2
+                     else (steady_walls[mid - 1] + steady_walls[mid]) / 2)
+        ep_spread = (steady_walls[-1] - steady_walls[0]) / ep_median if ep_median else 0.0
+    else:  # epochs_cap = 0: degenerate but must not crash
+        ep_median = ep_spread = 0.0
     return {
         "config": num,
         "name": name,
@@ -176,6 +196,13 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
         "target": target,
         "target_reached": t_target is not None,
         "wall_to_target_s": round(t_target, 2) if t_target is not None else None,
+        # single-shot wall above is tenancy-exposed; these qualify it:
+        "epoch_walls_s": [round(w, 2) for w in epoch_walls],
+        "epoch_wall_median_s": round(ep_median, 2),
+        "epoch_wall_spread": round(ep_spread, 3),
+        "wall_to_target_denoised_s": (
+            round(epoch_walls[0] + ep_median * (epochs_run - 1), 2)
+            if t_target is not None else None),
         # wall-inclusive rate (compile + train + eval — the user experience)
         "samples_per_sec_per_chip_wall": round(
             epochs_run * samples_per_epoch / wall / n_chips, 1),

@@ -52,7 +52,8 @@ from distkeras_tpu.models.decode import (dequant_embed, forward_with_cache,
 
 
 def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
-                                 max_new_tokens: int, *, k: int = 4):
+                                 max_new_tokens: int, *, k: int = 4,
+                                 with_stats: bool = False):
     """Build a jitted ``(target_params, draft_params, prompt [1, P]) ->
     tokens [1, max_new_tokens]`` — greedy; bit-identical to
     ``make_generate_fn(target_spec, ...)`` in float32 (see module docstring
@@ -62,6 +63,15 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     must share vocab; the draft is typically a smaller ``num_layers``/
     ``model_dim`` model (possibly int8-quantized — both param trees ride
     the decode module's QTensor support).
+
+    ``with_stats=True`` returns ``(tokens, iterations)`` where
+    ``iterations`` is the number of draft/verify rounds the while-loop ran.
+    The loop commits ``max_new_tokens - 1`` tokens (the first output token
+    comes from the prompt prefill, before the loop), each round committing
+    ``m + 1``, so mean accepted draft tokens per round is
+    ``(max_new_tokens - 1)/iterations - 1`` and the acceptance rate is
+    that divided by ``k`` — the number a benchmark must report for a
+    speculative-decoding claim to mean anything.
     """
     t_cfg, d_cfg = dict(target_spec.config), dict(draft_spec.config)
     for name, spec in (("target", target_spec), ("draft", draft_spec)):
@@ -104,12 +114,13 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
         pos = jnp.asarray(prompt_len, jnp.int32)  # cache rows valid below pos
         n_out = jnp.asarray(1, jnp.int32)
+        iters = jnp.asarray(0, jnp.int32)
 
         def cond(carry):
             return carry[0] < n
 
         def body(carry):
-            n_out, cur, pos, out, t_cache, d_cache = carry
+            n_out, cur, pos, out, iters, t_cache, d_cache = carry
 
             # 1. draft k tokens autoregressively from cur
             def draft_step(c, i):
@@ -149,10 +160,13 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
             _, d_cache = forward_with_cache(d_params, d_cfg,
                                             drafted[-1:][None, :], pos + k,
                                             d_cache, last_only=True)
-            return n_out + committed, cur, pos + committed, out, t_cache, d_cache
+            return (n_out + committed, cur, pos + committed, out, iters + 1,
+                    t_cache, d_cache)
 
-        n_out, cur, pos, out, _, _ = lax.while_loop(
-            cond, body, (n_out, cur, pos, out, t_cache, d_cache))
+        n_out, cur, pos, out, iters, _, _ = lax.while_loop(
+            cond, body, (n_out, cur, pos, out, iters, t_cache, d_cache))
+        if with_stats:
+            return out[:, :n], iters
         return out[:, :n]
 
     def generate_fn(t_params, d_params, prompt):

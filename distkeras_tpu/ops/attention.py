@@ -46,6 +46,18 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool
     return out
 
 
+def ring_block_impl(l_local: int) -> str:
+    """The per-block compute ``ring_attention`` auto-selects for a shard of
+    ``l_local`` positions on TPU: the flash kernel wins at l_local >= 2048
+    (device-time crossover, see ``ring_attention`` docstring; tracked by
+    ``bench.py``'s ``ring`` legs) and needs Mosaic-legal 128-divisible
+    blocks; dense-XLA otherwise.  Single source for the threshold — the
+    bench imports this instead of restating the rule."""
+    return ("flash" if (jax.default_backend() == "tpu" and l_local >= 2048
+                        and l_local % 128 == 0)
+            else "dense")
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: str,
                    causal: bool = True, impl: Optional[str] = None) -> jnp.ndarray:
     """Sequence-parallel attention under ``shard_map`` over ``axis_name``.
@@ -73,19 +85,19 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: st
       flash backward as a delta shift).
 
     ``impl``: ``None`` auto-selects — the flash kernel on TPU for shards
-    long enough to win (measured v5e crossover: 2.04x at l_local=4096,
-    1.42x at 2048, 0.65x at 1024 — small blocks can't amortize the
-    kernel's VPU overhead), dense-XLA otherwise (including CPU meshes,
-    where interpret-mode flash is also prohibitively slow for tests).
-    ``"flash"``/``"dense"`` force a path (CPU flash-ring composition
-    tests; numerical cross-checks).
+    long enough to win (measured v5e per-block crossover, DEVICE time
+    2026-07-31, tracked by ``bench.py``'s ``ring`` legs: 5.0x at
+    l_local=4096, 4.0x at 2048, 0.79x at 1024 — small blocks can't
+    amortize the kernel's VPU overhead), dense-XLA otherwise (including
+    CPU meshes, where interpret-mode flash is also prohibitively slow for
+    tests).  ``"flash"``/``"dense"`` force a path (CPU flash-ring
+    composition tests; numerical cross-checks).
     """
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
     if impl is None:
-        use_flash = (jax.default_backend() == "tpu" and l_local >= 2048
-                     and l_local % 128 == 0)
+        use_flash = ring_block_impl(l_local) == "flash"
     elif impl in ("flash", "dense"):
         use_flash = impl == "flash"
     else:

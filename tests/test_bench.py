@@ -51,3 +51,52 @@ def test_peak_flops_lookup():
     assert bench._peak_flops("TPU v5 lite") == 197e12
     assert bench._peak_flops("TPU v5p chip") == 459e12
     assert bench._peak_flops("Quantum Abacus 9000") is None
+
+
+def test_decode_bench_runs_tiny_on_cpu():
+    """The decode section (incl. the TRAINED speculative leg) at toy scale:
+    every leg present, spread recorded, acceptance_rate a real fraction."""
+    out = bench._bench_decode(batch=2, prompt_len=8, new_tokens=16,
+                              model_dim=32, num_heads=2, num_layers=2,
+                              vocab=64, reps=2, train_steps=8)
+    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "speculative_b1"):
+        assert out[mode]["tokens_per_sec"] > 0, mode
+        assert "wall_spread" in out[mode], mode
+    sp = out["speculative_b1"]
+    assert sp["trained"] is True
+    assert 0.0 <= sp["acceptance_rate"] <= 1.0
+    # CPU trace may or may not yield module events; the tag must say which
+    assert out["timing"] in ("device-median-of-2", "wall-median-of-2")
+    assert out["speculative_speedup_vs_fp_b1"] > 0
+
+
+def test_ring_bench_runs_tiny_on_cpu():
+    leg = bench._bench_ring(256, batch=1, heads=2, head_dim=64, steps=1)
+    assert leg["l_local"] == 256
+    assert leg["flash_ms"] > 0 and leg["dense_ms"] > 0
+    assert leg["auto_selects"] == "dense"
+    assert leg["timing"] in ("device", "wall")
+
+
+def test_lm_leg_baseline_keys_include_heads():
+    """A heads change must break the baseline match (no bogus ratio)."""
+    out = {"lm": [{"seq_len": 2048, "batch": 8, "model_dim": 512,
+                   "num_heads": 4, "tokens_per_sec": 100.0}]}
+    baseline = {"legs": {"lm:2048x8:d512h8": {"tokens_per_sec": 50.0}}}
+    bench._apply_leg_baselines(out, baseline)
+    assert "vs_baseline" not in out["lm"][0]
+    out["lm"][0]["num_heads"] = 8
+    bench._apply_leg_baselines(out, baseline)
+    assert out["lm"][0]["vs_baseline"] == 2.0
+
+
+def test_ring_baseline_ratio_inverted():
+    out = {"ring": [{"l_local": 2048, "flash_ms": 2.0, "timing": "device"}]}
+    baseline = {"legs": {"ring:2048": {"flash_ms": 4.0}}}
+    bench._apply_leg_baselines(out, baseline)
+    assert out["ring"][0]["vs_baseline"] == 2.0  # faster than recorded best
+
+    # a wall-fallback leg must NOT ratio against the device record
+    wall = {"ring": [{"l_local": 2048, "flash_ms": 2.0, "timing": "wall"}]}
+    bench._apply_leg_baselines(wall, baseline)
+    assert "vs_baseline" not in wall["ring"][0]
