@@ -173,15 +173,14 @@ def run_config(num: int, epochs_cap: int, batch_size: Optional[int] = None,
     epochs_run = len(accs)
     # the first epoch pays compilation; the median of the REMAINING epochs
     # is the de-noised per-epoch wall (falls back to all epochs when only
-    # one ran).  spread = (max-min)/median over the same set.  True median
-    # (middle pair averaged): the upper-middle element would hand a 2-epoch
-    # run its WORST epoch — the tenancy spike this column exists to remove.
-    steady_walls = sorted(epoch_walls[1:]) or sorted(epoch_walls)
+    # one ran).  spread = (max-min)/median over the same set.
+    import statistics
+
+    steady_walls = epoch_walls[1:] or epoch_walls
     if steady_walls:
-        mid = len(steady_walls) // 2
-        ep_median = (steady_walls[mid] if len(steady_walls) % 2
-                     else (steady_walls[mid - 1] + steady_walls[mid]) / 2)
-        ep_spread = (steady_walls[-1] - steady_walls[0]) / ep_median if ep_median else 0.0
+        ep_median = statistics.median(steady_walls)
+        ep_spread = ((max(steady_walls) - min(steady_walls)) / ep_median
+                     if ep_median else 0.0)
     else:  # epochs_cap = 0: degenerate but must not crash
         ep_median = ep_spread = 0.0
     return {

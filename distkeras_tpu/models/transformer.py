@@ -207,7 +207,8 @@ class TransformerLM(nn.Module):
 def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None, remat: bool = False,
-                  moe_experts: int = 0, moe_capacity: int = 0):
+                  moe_experts: int = 0, moe_capacity: int = 0,
+                  attn_impl: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
     return ModelSpec(
@@ -223,6 +224,11 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "remat": remat,
             "moe_experts": moe_experts,
             "moe_capacity": moe_capacity,
+            # None = auto-select per ops.attention.attention; "flash"/
+            # "dense" pin the kernel (the auto thresholds were measured at
+            # head_dim 64 — head_dim-128 models may want an explicit pin,
+            # see bench.py's lm legs)
+            "attn_impl": attn_impl,
         },
         input_shape=(max_seq_len,),
         input_dtype="int32",

@@ -206,13 +206,16 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
         axis_name = None  # traced outside any shard_map: dense is exact
     if axis_name is None:
         if impl is None:
-            # flash needs Mosaic-legal blocks AND enough total work to beat
-            # XLA's fused softmax-attention: measured on v5e (fwd+bwd,
-            # 2026-07-30 sweep) flash wins at B*L >= 16k tokens with
-            # L >= 2048 (1.2-1.7x) and loses below (0.8x at B=2, L=2048)
-            tokens = q.shape[0] * q.shape[1]
+            # flash wins on TPU whenever the sequence is long enough for
+            # Mosaic-legal blocks: measured on v5e DEVICE time (fwd+bwd,
+            # 2026-07-31 sweep) 1.1-1.9x at every L >= 2048 shape probed
+            # (b1-b8, head_dim 64 and 128, 2k-8k tokens).  The round-3
+            # rule additionally required B*L >= 16k tokens — that cutoff
+            # was an artifact of WALL timing (relay dispatch noise on
+            # small, fast steps); it cost the head_dim-128 LM legs 30-44%
+            # (e.g. the 1024-dim leg: dense 126.8 ms/step vs flash 88.1)
             impl = ("flash" if (jax.default_backend() == "tpu"
-                                and q.shape[1] >= 2048 and tokens >= 16384
+                                and q.shape[1] >= 2048
                                 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
                     else "dense")
         if impl == "flash":

@@ -91,12 +91,27 @@ def test_lm_leg_baseline_keys_include_heads():
 
 
 def test_ring_baseline_ratio_inverted():
-    out = {"ring": [{"l_local": 2048, "flash_ms": 2.0, "timing": "device"}]}
-    baseline = {"legs": {"ring:2048": {"flash_ms": 4.0}}}
+    leg = {"l_local": 2048, "batch": 1, "heads": 8, "head_dim": 64,
+           "flash_ms": 2.0, "timing": "device"}
+    out = {"ring": [dict(leg)]}
+    baseline = {"legs": {"ring:2048:b1h8d64": {"flash_ms": 4.0}}}
     bench._apply_leg_baselines(out, baseline)
     assert out["ring"][0]["vs_baseline"] == 2.0  # faster than recorded best
 
     # a wall-fallback leg must NOT ratio against the device record
-    wall = {"ring": [{"l_local": 2048, "flash_ms": 2.0, "timing": "wall"}]}
+    wall = {"ring": [dict(leg, timing="wall")]}
     bench._apply_leg_baselines(wall, baseline)
     assert "vs_baseline" not in wall["ring"][0]
+
+    # a config change (different heads) must break the match
+    other = {"ring": [dict(leg, heads=4)]}
+    bench._apply_leg_baselines(other, baseline)
+    assert "vs_baseline" not in other["ring"][0]
+
+
+def test_lm_wall_fallback_skips_baseline():
+    out = {"lm": [{"seq_len": 2048, "batch": 8, "model_dim": 512,
+                   "num_heads": 8, "timing": "wall", "tokens_per_sec": 100.0}]}
+    baseline = {"legs": {"lm:2048x8:d512h8": {"tokens_per_sec": 50.0}}}
+    bench._apply_leg_baselines(out, baseline)
+    assert "vs_baseline" not in out["lm"][0]
