@@ -637,8 +637,9 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     visibly.  Legs are matched by config key; a methodology or config
     change simply finds no match and reports no ratio."""
     for leg in out.get("lm", ()):
-        if leg.get("timing") == "wall":
-            continue  # wall fallback must not ratio against device records
+        if leg.get("timing") != "device":
+            continue  # wall fallback (or an untagged leg from an older
+            #           build) must not ratio against device records
         key = (f"lm:{leg.get('seq_len')}x{leg.get('batch')}"
                f":d{leg.get('model_dim', 512)}h{leg.get('num_heads', 8)}")
         base = baseline.get("legs", {}).get(key, {})

@@ -224,10 +224,9 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "remat": remat,
             "moe_experts": moe_experts,
             "moe_capacity": moe_capacity,
-            # None = auto-select per ops.attention.attention; "flash"/
-            # "dense" pin the kernel (the auto thresholds were measured at
-            # head_dim 64 — head_dim-128 models may want an explicit pin,
-            # see bench.py's lm legs)
+            # None = auto-select per ops.attention.attention (flash on TPU
+            # at L >= 2048, device-time validated across head_dim 64/128);
+            # "flash"/"dense" pin the kernel for A/B measurement
             "attn_impl": attn_impl,
         },
         input_shape=(max_seq_len,),

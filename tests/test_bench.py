@@ -81,7 +81,8 @@ def test_ring_bench_runs_tiny_on_cpu():
 def test_lm_leg_baseline_keys_include_heads():
     """A heads change must break the baseline match (no bogus ratio)."""
     out = {"lm": [{"seq_len": 2048, "batch": 8, "model_dim": 512,
-                   "num_heads": 4, "tokens_per_sec": 100.0}]}
+                   "num_heads": 4, "timing": "device",
+                   "tokens_per_sec": 100.0}]}
     baseline = {"legs": {"lm:2048x8:d512h8": {"tokens_per_sec": 50.0}}}
     bench._apply_leg_baselines(out, baseline)
     assert "vs_baseline" not in out["lm"][0]
