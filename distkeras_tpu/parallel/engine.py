@@ -378,13 +378,66 @@ class WindowEngine:
         """The trained center — reference ``parameter_server.get_model()``."""
         return Model(spec=self.spec, params=jax.tree.map(lambda x: jnp.asarray(x), state.center))
 
+    def _gather_rows(self, subtree):
+        """Compiled one-replica-row gather: a [R, ...]-leading sharded
+        pytree -> R replicated row pytrees, one collective per row.
+
+        Row-at-a-time keeps the PEAK extra device memory at O(one model
+        copy) instead of replicating the full O(model x replicas) stack
+        into every device's HBM — a state that only fits sharded must not
+        OOM at exactly the checkpoint/ensemble moment the gather exists
+        for.  SPMD caveat: this dispatches collectives, so in a
+        multi-process run EVERY process must call it with the same
+        state."""
+        fn = getattr(self, "_row_gather_fn", None)
+        if fn is None:
+            fn = jax.jit(
+                lambda t, i: jax.tree.map(lambda a: jnp.take(a, i, axis=0), t),
+                out_shardings=NamedSharding(self.mesh, P()))
+            self._row_gather_fn = fn  # fresh lambdas would defeat the jit cache
+        return [fn(subtree, jnp.int32(i)) for i in range(self.num_replicas)]
+
+    def gather_state(self, state: ReplicaState, to_host: bool = True) -> Optional[ReplicaState]:
+        """Full HOST copy of the training state, gathered row-by-row.
+
+        The sharded fields (``local``/``opt_state``/``extra``) are pulled
+        one replica row per collective (see ``_gather_rows``); ``center``
+        and ``step`` are already replicated and copy straight out.  This
+        is what makes checkpointing and ``local_models`` work when
+        replicas live on other hosts.
+
+        ``to_host=False`` runs ONLY the collectives (every process must
+        participate in them) and returns ``None`` without materializing
+        anything in host RAM — the non-writer processes of a checkpoint
+        save use this so an N-host run doesn't copy N-1 redundant full
+        states per epoch."""
+        rows = {name: self._gather_rows(getattr(state, name))
+                for name in ("local", "opt_state", "extra")}
+        if not to_host:
+            return None
+        stacked = {
+            name: jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                               *field_rows)
+            for name, field_rows in rows.items()
+        }
+        return ReplicaState(
+            center=jax.tree.map(np.asarray, state.center),
+            local=stacked["local"],
+            opt_state=stacked["opt_state"],
+            extra=stacked["extra"],
+            step=np.asarray(state.step),
+        )
+
     def local_models(self, state: ReplicaState) -> List[Model]:
-        """All per-replica models (EnsembleTrainer's return value)."""
+        """All per-replica models (EnsembleTrainer's return value).
+
+        Multi-process meshes gather the ``local`` field row-by-row (just
+        the weights — not the 2-3x larger optimizer slots), so every
+        process returns the identical full ensemble."""
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "local_models gathers every replica to the host; in a "
-                "multi-process run replicas live on other hosts — use "
-                "center_model/averaged_model (replicated results) instead")
+            rows = self._gather_rows(state.local)
+            return [Model(spec=self.spec,
+                          params=jax.tree.map(jnp.asarray, row)) for row in rows]
         local_np = jax.tree.map(np.asarray, state.local)
         models = []
         for i in range(self.num_replicas):

@@ -409,17 +409,24 @@ class DistributedTrainer(Trainer):
                 "early_stopping monitors validation metrics; pass "
                 "validation_data= (failing now beats training a full epoch "
                 "before the missing metric is noticed)")
-        if checkpointer is not None and jax.process_count() > 1:
-            raise NotImplementedError(
-                "checkpointing a multi-process mesh state is not wired up "
-                "(v1: per-replica leaves live on other hosts); checkpoint "
-                "single-process or snapshot center_model() yourself")
         self._es_best_params = None  # set when early stopping restores best
         engine = self.engine
         state = engine.init_state(self.model, divergent_seeds=self._divergent_seeds())
         start_epoch = 0
         if checkpointer is not None:
             ckpt_step = checkpointer.latest_step()
+            if jax.process_count() > 1:
+                # every process MUST resume from the same step or they
+                # issue different numbers of collectives and the job
+                # hangs: process 0's view of the spool is authoritative
+                # (it is the writer).  A process that then can't READ
+                # that step fails loudly — the checkpoint dir must be a
+                # shared filesystem.
+                from jax.experimental import multihost_utils
+
+                step = multihost_utils.broadcast_one_to_all(
+                    np.int64(-1 if ckpt_step is None else ckpt_step))
+                ckpt_step = None if int(step) < 0 else int(step)
             if ckpt_step is not None:
                 restored = checkpointer.restore({"state": state}, step=ckpt_step)["state"]
                 state = engine.shard_state(restored)
@@ -452,8 +459,31 @@ class DistributedTrainer(Trainer):
                     val = self._validate(vparams, validation_data)
                     self.metrics[-1].update(val)
                 if checkpointer is not None:
-                    checkpointer.save(epoch + 1, {"state": state},
-                                      metadata={"epochs_done": epoch + 1})
+                    if jax.process_count() > 1:
+                        # replicas live on other hosts: ALL processes run
+                        # the row-gather collectives, only process 0
+                        # materializes the host copy and writes.  The
+                        # barrier after the write is what makes the spool
+                        # consistent: without it another process can
+                        # finish train(), start a resumed run, and read
+                        # latest_step() BEFORE process 0's atomic rename
+                        # lands — divergent start_epochs then issue
+                        # mismatched collectives and the job hangs.  (If
+                        # process 0 dies mid-save the others block here
+                        # until the distributed runtime declares it dead
+                        # — a visible failure, not silent divergence.)
+                        from jax.experimental import multihost_utils
+
+                        writer = jax.process_index() == 0
+                        host_state = engine.gather_state(state, to_host=writer)
+                        if writer:
+                            checkpointer.save(epoch + 1, {"state": host_state},
+                                              metadata={"epochs_done": epoch + 1})
+                        multihost_utils.sync_global_devices(
+                            f"distkeras-ckpt-{epoch + 1}")
+                    else:
+                        checkpointer.save(epoch + 1, {"state": state},
+                                          metadata={"epochs_done": epoch + 1})
                 if stopper is not None and stopper.update(
                         epoch, self.metrics[-1], vparams):
                     if stopper.restore_best and stopper.best_params is not None:
@@ -582,13 +612,6 @@ class EnsembleTrainer(DistributedTrainer):
                 "ambiguous for an ensemble (N independent members, no "
                 "single center); evaluate the returned models with "
                 "ModelPredictor/AccuracyEvaluator")
-        if jax.process_count() > 1:
-            # fail BEFORE training: local_models gathers every replica to
-            # the host, which a multi-process mesh cannot do at the end
-            raise NotImplementedError(
-                "EnsembleTrainer returns every replica's weights, which "
-                "live on other hosts in a multi-process run; train "
-                "single-process or use AveragingTrainer (replicated result)")
         self.record_training_start()
         state = self._run_epochs(dataset, shuffle, checkpointer)
         models = self.engine.local_models(state)

@@ -1,0 +1,70 @@
+"""Child process for the 2-host CHECKPOINT + ENSEMBLE test (not collected
+by pytest).
+
+Closes the engine's last two multi-process NotImplementedErrors: a
+checkpoint written mid-run on a 2-process mesh (all processes all-gather,
+process 0 writes) must resume bit-exactly, and EnsembleTrainer must
+return the full per-replica ensemble on EVERY process.
+
+Usage: python multihost_child_ckpt.py <process_id> <num_processes> <port> <ckpt_dir>
+"""
+
+import json
+import sys
+
+proc_id, nprocs, port, ckdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4])
+
+from distkeras_tpu.runtime.launcher import initialize_multihost  # noqa: E402
+
+initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=nprocs, process_id=proc_id,
+                     cpu_devices_per_process=2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distkeras_tpu.checkpoint import Checkpointer  # noqa: E402
+from distkeras_tpu.models.base import ModelSpec  # noqa: E402
+from distkeras_tpu.trainers import ADAG, EnsembleTrainer  # noqa: E402
+from distkeras_tpu.utils import flatten_weights  # noqa: E402
+from tests.multihost_engine_common import make_toy  # noqa: E402
+
+assert jax.process_count() == nprocs
+dataset = make_toy()
+spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                 input_shape=(8,))
+kwargs = dict(loss="categorical_crossentropy", worker_optimizer="sgd",
+              learning_rate=0.05, num_workers=2 * nprocs, batch_size=8,
+              communication_window=2)
+
+
+def center_sum(model):
+    flat, _ = flatten_weights(model.params)
+    return float(sum(np.abs(np.asarray(w)).sum() for w in flat))
+
+
+# uninterrupted 3-epoch reference on this same 2-process mesh
+ref = ADAG(spec, num_epoch=3, **kwargs)
+ref_model = ref.train(dataset, shuffle=False)
+
+# 1 epoch with a checkpoint (all processes gather, process 0 writes) ...
+ck = Checkpointer(ckdir, keep=2)
+ADAG(spec, num_epoch=1, **kwargs).train(dataset, shuffle=False, checkpointer=ck)
+# ... then a FRESH trainer resumes from the shared spool to 3 epochs
+resumed = ADAG(spec, num_epoch=3, **kwargs)
+resumed_model = resumed.train(dataset, shuffle=False, checkpointer=ck)
+
+# ensemble across the process boundary: every process gets every replica
+ens = EnsembleTrainer(spec, num_epoch=2, **kwargs)
+models = ens.train(dataset, shuffle=False)
+
+print("RESULT " + json.dumps({
+    "process": proc_id,
+    "ref_losses": [round(float(x), 8) for x in ref.history],
+    "resumed_losses": [round(float(x), 8) for x in resumed.history],
+    "ref_center_sum": round(center_sum(ref_model), 6),
+    "resumed_center_sum": round(center_sum(resumed_model), 6),
+    "epochs_done": int(ck.metadata()["metadata"]["epochs_done"]),
+    "ensemble_sums": [round(center_sum(m), 6) for m in models],
+}), flush=True)
