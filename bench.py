@@ -236,6 +236,25 @@ def _bench_lm(seq_len: int, batch: int, *, model_dim: int = 512, num_heads: int 
     }
 
 
+def _ab_kernel_ms(flash_loss, dense_loss, steps: int, q, k, v):
+    """Shared flash-vs-dense A/B harness for the attn and ring legs:
+    per-step on-device ms for both impls via ``_grad_scan_runner`` +
+    ``_device_time_ms``.  Returns (flash_ms, dense_ms, timing, speedup);
+    ``speedup`` is None when the two sides resolved to DIFFERENT timing
+    sources (one device, one wall fallback) — a wall/device ratio would
+    fold the relay dispatch share into a "kernel speedup"."""
+    def one(loss):
+        run = _grad_scan_runner(loss, steps)
+        ms, _, src = _device_time_ms(run, q, k, v, reps=2)
+        return ms / steps, src
+
+    f_ms, f_src = one(flash_loss)
+    d_ms, d_src = one(dense_loss)
+    timing = "device" if f_src == d_src == "device" else "wall"
+    speedup = round(d_ms / f_ms, 2) if f_src == d_src else None
+    return f_ms, d_ms, timing, speedup
+
+
 def _grad_scan_runner(loss_fn, steps: int):
     """Jitted fwd+bwd timing harness shared by the attn and ring benches:
     ``steps`` gradient steps inside ONE program (lax.scan), feeding each
@@ -265,11 +284,9 @@ def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int =
                 steps: int = 50):
     """Kernel microbench: Pallas flash vs XLA dense attention, fwd+bwd.
 
-    ``steps`` must be large enough to amortize the one-dispatch RPC cost of
-    the relayed axon platform (~50-100ms): at steps=5 the 2k-token per-step
-    figure read ~25ms when the kernel actually takes ~3.3ms.  (Still WALL
-    time — the recorded attn baselines predate the device-time methodology
-    and stay comparable.)"""
+    On-device timing (``_device_time_ms``) like every other kernel leg —
+    the wall variant of this bench is where the round-3 "flash needs
+    B*L >= 16k tokens" misread came from."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -281,23 +298,20 @@ def _bench_attn(seq_len: int, *, batch: int = 2, heads: int = 8, head_dim: int =
     q, k, v = (jnp.asarray(rng.normal(size=shape) * 0.1, dtype=jnp.bfloat16)
                for _ in range(3))
 
-    def timed(fn):
+    def loss_of(fn):
         def loss(q, k, v):
             return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
 
-        run = _grad_scan_runner(loss, steps)
-        np.asarray(run(q, k, v))  # compile
-        t0 = time.perf_counter()
-        np.asarray(run(q, k, v))
-        return (time.perf_counter() - t0) / steps * 1e3  # ms
+        return loss
 
-    flash_ms = timed(flash_attention)
-    dense_ms = timed(dense_attention)
+    flash_ms, dense_ms, timing, speedup = _ab_kernel_ms(
+        loss_of(flash_attention), loss_of(dense_attention), steps, q, k, v)
     return {
         "seq_len": seq_len,
-        "flash_ms": round(flash_ms, 2),
-        "dense_ms": round(dense_ms, 2),
-        "flash_speedup": round(dense_ms / flash_ms, 2),
+        "flash_ms": round(flash_ms, 3),
+        "dense_ms": round(dense_ms, 3),
+        "flash_speedup": speedup,
+        "timing": timing,
     }
 
 
@@ -594,20 +608,19 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
         return (o / l_sum.transpose(0, 2, 1)[..., None]).astype(q.dtype), \
             m + jnp.log(l_sum)
 
-    def timed(fn):
+    def loss_of(fn):
         def loss(q, k, v):
             o, lse = fn(q, k, v, causal=True)
             # both outputs live (the ring merge differentiates through lse)
             return jnp.sum(o.astype(jnp.float32)) + 1e-3 * jnp.sum(lse)
 
-        run = _grad_scan_runner(loss, steps)
-        ms, _, source = _device_time_ms(run, q, k, v, reps=2)
-        return ms / steps, source
+        return loss
 
     from distkeras_tpu.ops.attention import ring_block_impl
 
-    flash_ms, f_src = timed(flash_attention_with_lse)
-    dense_ms, d_src = timed(dense_with_lse)
+    flash_ms, dense_ms, timing, speedup = _ab_kernel_ms(
+        loss_of(flash_attention_with_lse), loss_of(dense_with_lse),
+        steps, q, k, v)
     return {
         "l_local": l_local,
         "batch": batch,
@@ -615,8 +628,8 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
         "head_dim": head_dim,
         "flash_ms": round(flash_ms, 3),
         "dense_ms": round(dense_ms, 3),
-        "flash_speedup": round(dense_ms / flash_ms, 2),
-        "timing": ("device" if f_src == d_src == "device" else "wall"),
+        "flash_speedup": speedup,
+        "timing": timing,
         # what ring_attention actually auto-selects for this shard length
         # (shared predicate — restating the threshold here would hide the
         # drift this leg exists to catch)
@@ -647,7 +660,11 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
         if r is not None:
             leg["vs_baseline"] = r
     for leg in out.get("attn", ()):
-        key = f"attn:{leg.get('seq_len')}"
+        if leg.get("timing") != "device":
+            continue  # wall fallback must not ratio against device records
+        # ":device" in the key so a stale wall-era record (or a checkout
+        # whose json predates the methodology switch) can never match
+        key = f"attn:{leg.get('seq_len')}:device"
         base = baseline.get("legs", {}).get(key, {})
         # ms ratio inverted so > 1 still means "faster than baseline"
         r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
@@ -657,7 +674,7 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
         if leg.get("timing") != "device":
             continue  # wall fallback must not ratio against device records
         key = (f"ring:{leg.get('l_local')}:b{leg.get('batch', 1)}"
-               f"h{leg.get('heads', 8)}d{leg.get('head_dim', 64)}")
+               f"h{leg.get('heads', 8)}d{leg.get('head_dim', 64)}:device")
         base = baseline.get("legs", {}).get(key, {})
         r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
         if r is not None:

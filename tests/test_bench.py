@@ -95,7 +95,7 @@ def test_ring_baseline_ratio_inverted():
     leg = {"l_local": 2048, "batch": 1, "heads": 8, "head_dim": 64,
            "flash_ms": 2.0, "timing": "device"}
     out = {"ring": [dict(leg)]}
-    baseline = {"legs": {"ring:2048:b1h8d64": {"flash_ms": 4.0}}}
+    baseline = {"legs": {"ring:2048:b1h8d64:device": {"flash_ms": 4.0}}}
     bench._apply_leg_baselines(out, baseline)
     assert out["ring"][0]["vs_baseline"] == 2.0  # faster than recorded best
 
