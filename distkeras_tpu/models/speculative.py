@@ -32,9 +32,17 @@ cache row sequential drafting didn't write, so both caches stay
 row-aligned with the committed sequence.
 
 The whole generation — both prefills and the while-loop of
-draft/verify/commit iterations — is one compiled program.  v1 limits:
-batch 1, greedy only, no EOS early-exit (generation always fills
-``max_new_tokens``).
+draft/verify/commit iterations — is one compiled program.
+
+Batched decoding commits in LOCKSTEP: each round accepts the batch
+MINIMUM agreeing prefix, so every row advances the shared cache write
+position together and the cache machinery stays identical to batch 1.
+Rows whose own prefix was longer commit tokens that their verification
+already endorsed (their accepted draft token equals their greedy token at
+every committed position), so per-row outputs remain exact greedy decodes
+— the batch minimum costs throughput (expected accepted prefix shrinks
+as agreement^batch per position), never correctness.  Limits: greedy
+only, no EOS early-exit (generation always fills ``max_new_tokens``).
 """
 
 from __future__ import annotations
@@ -54,10 +62,10 @@ from distkeras_tpu.models.decode import (dequant_embed, forward_with_cache,
 def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                  max_new_tokens: int, *, k: int = 4,
                                  with_stats: bool = False):
-    """Build a jitted ``(target_params, draft_params, prompt [1, P]) ->
-    tokens [1, max_new_tokens]`` — greedy; bit-identical to
+    """Build a jitted ``(target_params, draft_params, prompt [B, P]) ->
+    tokens [B, max_new_tokens]`` — greedy; bit-identical to
     ``make_generate_fn(target_spec, ...)`` in float32 (see module docstring
-    for the bfloat16 near-tie caveat).
+    for the bfloat16 near-tie caveat and the batched lockstep-commit rule).
 
     ``k`` = draft tokens proposed per verification step.  The two specs
     must share vocab; the draft is typically a smaller ``num_layers``/
@@ -91,6 +99,7 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     @functools.partial(jax.jit, static_argnames=("prompt_len",))
     def run(t_params, d_params, prompt, prompt_len):
         n = max_new_tokens
+        b = prompt.shape[0]
         total = prompt_len + n + k + 1  # speculative writes may run past n
         for name, cfg in (("target", t_cfg), ("draft", d_cfg)):
             if total > cfg["max_seq_len"]:
@@ -99,18 +108,18 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                     f"{name} max_seq_len = {cfg['max_seq_len']}")
         t_params = dequant_embed(t_params)
         d_params = dequant_embed(d_params)
-        t_cache = init_cache(t_cfg, 1, total)
-        d_cache = init_cache(d_cfg, 1, total)
+        t_cache = init_cache(t_cfg, b, total)
+        d_cache = init_cache(d_cfg, b, total)
 
         t_logits, t_cache = forward_with_cache(t_params, t_cfg, prompt, 0,
                                                t_cache, last_only=True)
         _, d_cache = forward_with_cache(d_params, d_cfg, prompt, 0, d_cache,
                                         last_only=True)
-        cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [1]
+        cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
 
         # out buffer padded by k+1: each iteration writes a full k+1 slab at
         # n_out; uncommitted tail is overwritten by the next iteration
-        out = jnp.zeros((1, n + k + 1), jnp.int32)
+        out = jnp.zeros((b, n + k + 1), jnp.int32)
         out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
         pos = jnp.asarray(prompt_len, jnp.int32)  # cache rows valid below pos
         n_out = jnp.asarray(1, jnp.int32)
@@ -122,7 +131,7 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         def body(carry):
             n_out, cur, pos, out, iters, t_cache, d_cache = carry
 
-            # 1. draft k tokens autoregressively from cur
+            # 1. draft k tokens autoregressively from cur (whole batch)
             def draft_step(c, i):
                 tok, cache = c
                 logits, cache = forward_with_cache(d_params, d_cfg,
@@ -132,25 +141,31 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
 
             (_, d_cache), drafted = lax.scan(draft_step, (cur, d_cache),
                                              jnp.arange(k))
-            drafted = drafted[:, 0]  # [k]
+            drafted = drafted.T  # [B, k]
 
             # 2. target scores the whole window [cur, d_1..d_k] in one pass
-            window = jnp.concatenate([cur, drafted])[None, :]  # [1, k+1]
+            window = jnp.concatenate([cur[:, None], drafted], axis=1)  # [B, k+1]
             t_logits, t_cache = forward_with_cache(t_params, t_cfg, window,
                                                    pos, t_cache)
-            greedy = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+            greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, k+1]
 
-            # 3. longest agreeing prefix: m accepted draft tokens (0..k)
-            matches = (drafted == greedy[:k]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(matches))
-            # commit slab: d_1..d_m, then the target's correction (m < k)
-            # or bonus (m == k) token greedy[m]; tail is dead weight
+            # 3. lockstep commit: every row's agreeing prefix, truncated to
+            # the batch MINIMUM so all rows advance the shared cache
+            # position together.  Positions < m are accepted by EVERY row,
+            # and row r's token at position m is its own greedy[r, m]
+            # (its correction when m == m_r, its accepted draft token —
+            # which EQUALS greedy[r, m] — when m < m_r), so each row's
+            # output is still exactly a greedy decode of the target.
+            # Batch-1 reduces to the classic per-row rule (min over 1 row).
+            matches = (drafted == greedy[:, :k]).astype(jnp.int32)
+            m = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
             idx = jnp.arange(k + 1)
-            slab = jnp.where(idx < m, jnp.concatenate([drafted, drafted[-1:]]),
-                             jnp.take(greedy, m))
-            out = lax.dynamic_update_slice(out, slab[None, :], (0, n_out))
+            padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
+            slab = jnp.where(idx[None, :] < m, padded,
+                             jnp.take(greedy, m, axis=1)[:, None])  # [B, k+1]
+            out = lax.dynamic_update_slice(out, slab, (0, n_out))
             committed = m + 1
-            cur = jnp.take(slab, m)[None]
+            cur = jnp.take(slab, m, axis=1)  # [B]
 
             # 4. complete the draft cache: sequential drafting wrote rows
             # pos..pos+k-1 for [cur, d_1..d_{k-1}]; only the d_k row at
@@ -158,7 +173,7 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
             # rows depend only on (token, position)).  Rows past
             # pos+committed are dead until decoding resumes there
             _, d_cache = forward_with_cache(d_params, d_cfg,
-                                            drafted[-1:][None, :], pos + k,
+                                            drafted[:, -1:], pos + k,
                                             d_cache, last_only=True)
             return (n_out + committed, cur, pos + committed, out, iters + 1,
                     t_cache, d_cache)
@@ -171,9 +186,6 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
 
     def generate_fn(t_params, d_params, prompt):
         prompt = jnp.asarray(prompt)
-        if prompt.shape[0] != 1:
-            raise ValueError("speculative decoding is batch-1 (v1); got "
-                             f"batch {prompt.shape[0]}")
         return run(t_params, d_params, prompt, prompt.shape[1])
 
     return generate_fn

@@ -59,12 +59,14 @@ def test_decode_bench_runs_tiny_on_cpu():
     out = bench._bench_decode(batch=2, prompt_len=8, new_tokens=16,
                               model_dim=32, num_heads=2, num_layers=2,
                               vocab=64, reps=2, train_steps=8)
-    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "speculative_b1"):
+    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "speculative_b1",
+                 "speculative_batched"):
         assert out[mode]["tokens_per_sec"] > 0, mode
         assert "wall_spread" in out[mode], mode
-    sp = out["speculative_b1"]
-    assert sp["trained"] is True
-    assert 0.0 <= sp["acceptance_rate"] <= 1.0
+    for sp in (out["speculative_b1"], out["speculative_batched"]):
+        assert sp["trained"] is True
+        assert 0.0 <= sp["acceptance_rate"] <= 1.0
+    assert out["speculative_speedup_vs_fp_batched"] > 0
     # CPU trace may or may not yield module events; the tag must say which
     assert out["timing"] in ("device-median-of-2", "wall-median-of-2")
     assert out["speculative_speedup_vs_fp_b1"] > 0
