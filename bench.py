@@ -589,6 +589,7 @@ _LM_LEGS = (
     (2048, 4, 1024, 16, 8, 30),
     (2048, 8, 512, 8, 4, 100),
     (8192, 2, 512, 8, 4, 50),
+    (32768, 1, 512, 8, 4, 8),
 )
 
 
@@ -709,8 +710,8 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     # the batch in their key; the *_b1 modes always run batch 1 and must
     # NOT be invalidated by a section-batch change
     batched_modes = {"fp", "int8", "fp_trained", "speculative_batched"}
-    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "speculative_b1",
-                 "speculative_batched"):
+    for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "fp_trained",
+                 "speculative_b1", "speculative_batched"):
         sub = dec.get(mode)
         # methodology-coded key: generation length and timing stat are part
         # of the identity, so the round-3 min-of-2-wall/256-token records
