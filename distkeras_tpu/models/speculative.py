@@ -41,8 +41,14 @@ Rows whose own prefix was longer commit tokens that their verification
 already endorsed (their accepted draft token equals their greedy token at
 every committed position), so per-row outputs remain exact greedy decodes
 — the batch minimum costs throughput (expected accepted prefix shrinks
-as agreement^batch per position), never correctness.  Limits: greedy
-only, no EOS early-exit (generation always fills ``max_new_tokens``).
+as agreement^batch per position), never correctness.
+
+``temperature > 0`` switches from greedy verification to exact
+speculative SAMPLING (:func:`speculative_accept`): proposals are sampled
+from the draft and accepted with prob ``min(1, p/q)``, rejections
+resample the residual — committed tokens are exact temperature-T target
+samples, in distribution rather than bit-equality.  Remaining limit: no
+EOS early-exit (generation always fills ``max_new_tokens``).
 """
 
 from __future__ import annotations
@@ -55,12 +61,50 @@ import jax.numpy as jnp
 from jax import lax
 
 from distkeras_tpu.models.base import ModelSpec
-from distkeras_tpu.models.decode import (dequant_embed, forward_with_cache,
-                                         init_cache)
+from distkeras_tpu.models.decode import (_sample, dequant_embed,
+                                         forward_with_cache, init_cache)
+
+
+def speculative_accept(key, target_probs, draft_probs, drafted):
+    """One row's exact speculative-SAMPLING acceptance (the standard
+    accept/residual scheme: Leviathan et al. / Chen et al. 2023).
+
+    ``target_probs`` [k+1, V] — the target distribution after each prefix
+    position of the verification window; ``draft_probs`` [k, V] — the
+    draft distribution each proposal was sampled from; ``drafted`` [k].
+    Returns ``(m, token_m)``: the number of accepted proposals and the
+    token to commit at position ``m``.
+
+    Rule: proposal i is accepted iff ``u_i * q(x_i) < p(x_i)`` (i.e.
+    ``u_i < min(1, p/q)``); on the first rejection the committed token is
+    sampled from the normalized residual ``max(p - q, 0)``; if all k are
+    accepted it is a bonus sample from ``target_probs[k]`` (the residual
+    expression reduces to exactly that because q is set to 0 there).
+    Per-position committed-token marginals equal the target distribution
+    — the property ``tests/test_speculative.py`` checks in closed form
+    and statistically.
+    """
+    k_ = drafted.shape[0]
+    u = jax.random.uniform(jax.random.fold_in(key, 0), (k_,))
+    p_x = jnp.take_along_axis(target_probs[:k_], drafted[:, None], 1)[:, 0]
+    q_x = jnp.take_along_axis(draft_probs, drafted[:, None], 1)[:, 0]
+    # u*q < p  <=>  u < p/q, and stays well-defined at q == 0 (accept iff
+    # p > 0 — a zero-probability proposal can only appear through argmax
+    # ties or numerics, and the rule still keeps the output exact)
+    accept = (u * q_x < p_x).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(accept))
+    p_m = jnp.take(target_probs, m, axis=0)
+    q_m = jnp.where(m < k_,
+                    jnp.take(draft_probs, jnp.minimum(m, k_ - 1), axis=0), 0.0)
+    residual = jnp.maximum(p_m - q_m, 0.0)
+    token = jax.random.categorical(jax.random.fold_in(key, 1),
+                                   jnp.log(residual + 1e-30))
+    return m, token.astype(jnp.int32)
 
 
 def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                  max_new_tokens: int, *, k: int = 4,
+                                 temperature: float = 0.0,
                                  with_stats: bool = False):
     """Build a jitted ``(target_params, draft_params, prompt [B, P]) ->
     tokens [B, max_new_tokens]`` — greedy; bit-identical to
@@ -71,6 +115,16 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     must share vocab; the draft is typically a smaller ``num_layers``/
     ``model_dim`` model (possibly int8-quantized — both param trees ride
     the decode module's QTensor support).
+
+    ``temperature > 0`` switches to exact speculative SAMPLING: the draft
+    samples its proposals from ``softmax(logits/T)`` and each proposal is
+    accepted/resampled by :func:`speculative_accept`, so every committed
+    token is distributed exactly as a plain temperature-``T`` sample from
+    the target (the draft changes the schedule, never the distribution —
+    same contract as the greedy path, now in distribution rather than
+    bit-equality).  The returned fn then takes an optional ``rng`` last
+    argument (default ``PRNGKey(0)``).  Batched sampling uses the same
+    lockstep batch-minimum commit as greedy.
 
     ``with_stats=True`` returns ``(tokens, iterations)`` where
     ``iterations`` is the number of draft/verify rounds the while-loop ran.
@@ -95,9 +149,14 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                          f"draft {d_cfg['vocab_size']}")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if not temperature >= 0.0:  # also rejects NaN
+        raise ValueError(f"temperature must be >= 0, got {temperature} "
+                         "(a negative value would silently select greedy)")
+
+    sampling = temperature > 0.0
 
     @functools.partial(jax.jit, static_argnames=("prompt_len",))
-    def run(t_params, d_params, prompt, prompt_len):
+    def run(t_params, d_params, prompt, rng, prompt_len):
         n = max_new_tokens
         b = prompt.shape[0]
         total = prompt_len + n + k + 1  # speculative writes may run past n
@@ -115,7 +174,12 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                                t_cache, last_only=True)
         _, d_cache = forward_with_cache(d_params, d_cfg, prompt, 0, d_cache,
                                         last_only=True)
-        cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+        if sampling:
+            rng, sub = jax.random.split(rng)
+            cur = _sample(t_logits[:, -1].astype(jnp.float32), sub,
+                          temperature, 0)  # [B]
+        else:
+            cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
 
         # out buffer padded by k+1: each iteration writes a full k+1 slab at
         # n_out; uncommitted tail is overwritten by the next iteration
@@ -129,40 +193,71 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
             return carry[0] < n
 
         def body(carry):
-            n_out, cur, pos, out, iters, t_cache, d_cache = carry
+            n_out, cur, pos, out, iters, rng, t_cache, d_cache = carry
+            if sampling:
+                rng, k_draft, k_verify = jax.random.split(rng, 3)
 
-            # 1. draft k tokens autoregressively from cur (whole batch)
+            # 1. draft k tokens autoregressively from cur (whole batch):
+            # greedy argmax, or (sampling) draws from softmax(logits/T)
+            # with the full draft distribution recorded for the accept rule
             def draft_step(c, i):
                 tok, cache = c
                 logits, cache = forward_with_cache(d_params, d_cfg,
                                                    tok[:, None], pos + i, cache)
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (nxt, cache), nxt
+                logits = logits[:, -1].astype(jnp.float32)
+                if sampling:
+                    scaled = logits / temperature
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(k_draft, i), scaled,
+                        axis=-1).astype(jnp.int32)
+                    return (nxt, cache), (nxt, jax.nn.softmax(scaled, axis=-1))
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, cache), (nxt, jnp.float32(0))
 
-            (_, d_cache), drafted = lax.scan(draft_step, (cur, d_cache),
-                                             jnp.arange(k))
+            (_, d_cache), (drafted, d_probs) = lax.scan(
+                draft_step, (cur, d_cache), jnp.arange(k))
             drafted = drafted.T  # [B, k]
 
             # 2. target scores the whole window [cur, d_1..d_k] in one pass
             window = jnp.concatenate([cur[:, None], drafted], axis=1)  # [B, k+1]
             t_logits, t_cache = forward_with_cache(t_params, t_cfg, window,
                                                    pos, t_cache)
-            greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, k+1]
 
-            # 3. lockstep commit: every row's agreeing prefix, truncated to
-            # the batch MINIMUM so all rows advance the shared cache
-            # position together.  Positions < m are accepted by EVERY row,
-            # and row r's token at position m is its own greedy[r, m]
-            # (its correction when m == m_r, its accepted draft token —
-            # which EQUALS greedy[r, m] — when m < m_r), so each row's
-            # output is still exactly a greedy decode of the target.
-            # Batch-1 reduces to the classic per-row rule (min over 1 row).
-            matches = (drafted == greedy[:, :k]).astype(jnp.int32)
-            m = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+            # 3. per-row accepted-prefix length m_r and the token each row
+            # would commit at its own boundary
+            if sampling:
+                t_probs = jax.nn.softmax(
+                    t_logits.astype(jnp.float32) / temperature, axis=-1)
+                row_keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                    k_verify, jnp.arange(b))
+                m_rows, token_rows = jax.vmap(speculative_accept)(
+                    row_keys, t_probs, d_probs.transpose(1, 0, 2), drafted)
+            else:
+                greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                matches = (drafted == greedy[:, :k]).astype(jnp.int32)
+                m_rows = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+                token_rows = None  # greedy[:, m] is taken after m is known
+
+            # lockstep commit: truncate every row to the batch MINIMUM so
+            # all rows advance the shared cache position together.
+            # Positions < m are accepted by EVERY row; at position m a row
+            # whose private prefix ran longer (m_r > m) commits its own
+            # ACCEPTED proposal drafted[r, m] (== its greedy token in the
+            # greedy mode; an exact-marginal sample in sampling mode),
+            # and a row with m_r == m commits its correction/residual
+            # token — so each row's output stays an exact greedy decode /
+            # exact temperature-T sample of the target.  Batch-1 reduces
+            # to the classic per-row rule (min over 1 row).
+            m = jnp.min(m_rows)
+            if sampling:
+                own = jnp.take(drafted, jnp.minimum(m, k - 1), axis=1)
+                token_m = jnp.where(m_rows > m, own, token_rows)
+            else:
+                token_m = jnp.take(greedy, m, axis=1)
             idx = jnp.arange(k + 1)
             padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
             slab = jnp.where(idx[None, :] < m, padded,
-                             jnp.take(greedy, m, axis=1)[:, None])  # [B, k+1]
+                             token_m[:, None])  # [B, k+1]
             out = lax.dynamic_update_slice(out, slab, (0, n_out))
             committed = m + 1
             cur = jnp.take(slab, m, axis=1)  # [B]
@@ -176,16 +271,18 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                             drafted[:, -1:], pos + k,
                                             d_cache, last_only=True)
             return (n_out + committed, cur, pos + committed, out, iters + 1,
-                    t_cache, d_cache)
+                    rng, t_cache, d_cache)
 
-        n_out, cur, pos, out, iters, _, _ = lax.while_loop(
-            cond, body, (n_out, cur, pos, out, iters, t_cache, d_cache))
+        n_out, cur, pos, out, iters, _, _, _ = lax.while_loop(
+            cond, body, (n_out, cur, pos, out, iters, rng, t_cache, d_cache))
         if with_stats:
             return out[:, :n], iters
         return out[:, :n]
 
-    def generate_fn(t_params, d_params, prompt):
+    def generate_fn(t_params, d_params, prompt, rng=None):
         prompt = jnp.asarray(prompt)
-        return run(t_params, d_params, prompt, prompt.shape[1])
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return run(t_params, d_params, prompt, rng, prompt.shape[1])
 
     return generate_fn
