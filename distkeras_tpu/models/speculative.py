@@ -47,14 +47,18 @@ as agreement^batch per position), never correctness.
 speculative SAMPLING (:func:`speculative_accept`): proposals are sampled
 from the draft and accepted with prob ``min(1, p/q)``, rejections
 resample the residual — committed tokens are exact temperature-T target
-samples, in distribution rather than bit-equality.  Remaining limit: no
-EOS early-exit (generation always fills ``max_new_tokens``).
+samples, in distribution rather than bit-equality.
+
+``eos_id`` enables EOS with the plain decoder's exact semantics (EOS
+kept, pads after, per row) and the loop exits EARLY once every row is
+done; finished rows are credited a full accept so their pad-fed drafts
+cannot throttle the live rows' lockstep minimum.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -105,6 +109,7 @@ def speculative_accept(key, target_probs, draft_probs, drafted):
 def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                  max_new_tokens: int, *, k: int = 4,
                                  temperature: float = 0.0,
+                                 eos_id: Optional[int] = None, pad_id: int = 0,
                                  with_stats: bool = False):
     """Build a jitted ``(target_params, draft_params, prompt [B, P]) ->
     tokens [B, max_new_tokens]`` — greedy; bit-identical to
@@ -115,6 +120,12 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     must share vocab; the draft is typically a smaller ``num_layers``/
     ``model_dim`` model (possibly int8-quantized — both param trees ride
     the decode module's QTensor support).
+
+    ``eos_id`` enables EOS handling with ``make_generate_fn``'s exact
+    semantics: the EOS token itself is kept, rows past it emit ``pad_id``,
+    and the loop exits EARLY once every row is done (the committed-token
+    contract makes the pre-EOS prefix identical to the plain decoder's,
+    so the two paths stay output-equal with or without EOS).
 
     ``temperature > 0`` switches to exact speculative SAMPLING: the draft
     samples its proposals from ``softmax(logits/T)`` and each proposal is
@@ -128,12 +139,14 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
 
     ``with_stats=True`` returns ``(tokens, iterations)`` where
     ``iterations`` is the number of draft/verify rounds the while-loop ran.
-    The loop commits ``max_new_tokens - 1`` tokens (the first output token
-    comes from the prompt prefill, before the loop), each round committing
-    ``m + 1``, so mean accepted draft tokens per round is
+    Without EOS the loop commits ``max_new_tokens - 1`` tokens (the first
+    output token comes from the prompt prefill, before the loop), each
+    round committing ``m + 1``, so mean accepted draft tokens per round is
     ``(max_new_tokens - 1)/iterations - 1`` and the acceptance rate is
     that divided by ``k`` — the number a benchmark must report for a
-    speculative-decoding claim to mean anything.
+    speculative-decoding claim to mean anything.  (Under an EOS early
+    exit fewer tokens are committed, so that formula UNDERSTATES nothing
+    but the benchmarks run without EOS.)
     """
     t_cfg, d_cfg = dict(target_spec.config), dict(draft_spec.config)
     for name, spec in (("target", target_spec), ("draft", draft_spec)):
@@ -188,12 +201,16 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         pos = jnp.asarray(prompt_len, jnp.int32)  # cache rows valid below pos
         n_out = jnp.asarray(1, jnp.int32)
         iters = jnp.asarray(0, jnp.int32)
+        # the EOS token itself is kept in the output; rows pad after it
+        done = (jnp.zeros(b, bool) if eos_id is None else cur == eos_id)
 
         def cond(carry):
-            return carry[0] < n
+            # early exit once EVERY row is done — the speculative loop's
+            # version of the plain decoder's carried-done convention
+            return (carry[0] < n) & ~jnp.all(carry[8])
 
         def body(carry):
-            n_out, cur, pos, out, iters, rng, t_cache, d_cache = carry
+            n_out, cur, pos, out, iters, rng, t_cache, d_cache, done = carry
             if sampling:
                 rng, k_draft, k_verify = jax.random.split(rng, 3)
 
@@ -238,6 +255,14 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                 m_rows = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
                 token_rows = None  # greedy[:, m] is taken after m is known
 
+            if eos_id is not None:
+                # rows that finished BEFORE this round draft pad-fed
+                # garbage; letting their arbitrary m_r into the batch
+                # minimum would throttle every live row toward 1 token/
+                # round.  Their slab is fully pad-masked below, so
+                # crediting them a full accept is safe and removes the drag
+                m_rows = jnp.where(done, k, m_rows)
+
             # lockstep commit: truncate every row to the batch MINIMUM so
             # all rows advance the shared cache position together.
             # Positions < m are accepted by EVERY row; at position m a row
@@ -258,6 +283,20 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
             padded = jnp.concatenate([drafted, drafted[:, -1:]], axis=1)
             slab = jnp.where(idx[None, :] < m, padded,
                              token_m[:, None])  # [B, k+1]
+            if eos_id is not None:
+                # committed positions strictly AFTER a row's first EOS (or
+                # every position of an already-done row) become pad_id;
+                # EOS beyond the committed prefix is dead weight and must
+                # not latch `done`.  Rows whose pre-EOS tokens are exact
+                # stay exact — only the padded tail differs from the raw
+                # slab, exactly like the plain decoder's carried-done rule.
+                committed_mask = idx[None, :] <= m
+                is_eos = (slab == eos_id) & committed_mask
+                eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                              - is_eos.astype(jnp.int32)) > 0
+                after = done[:, None] | eos_before
+                slab = jnp.where(after, pad_id, slab)
+                done = done | jnp.any(is_eos, axis=1)
             out = lax.dynamic_update_slice(out, slab, (0, n_out))
             committed = m + 1
             cur = jnp.take(slab, m, axis=1)  # [B]
@@ -271,10 +310,15 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                             drafted[:, -1:], pos + k,
                                             d_cache, last_only=True)
             return (n_out + committed, cur, pos + committed, out, iters + 1,
-                    rng, t_cache, d_cache)
+                    rng, t_cache, d_cache, done)
 
-        n_out, cur, pos, out, iters, _, _, _ = lax.while_loop(
-            cond, body, (n_out, cur, pos, out, iters, rng, t_cache, d_cache))
+        n_out, cur, pos, out, iters, _, _, _, done = lax.while_loop(
+            cond, body,
+            (n_out, cur, pos, out, iters, rng, t_cache, d_cache, done))
+        if eos_id is not None:
+            # an early exit leaves columns n_out..n unwritten (zeros);
+            # they belong to all-done rows and must read as pad_id
+            out = jnp.where(jnp.arange(n + k + 1)[None, :] < n_out, out, pad_id)
         if with_stats:
             return out[:, :n], iters
         return out[:, :n]

@@ -81,6 +81,46 @@ def test_batched_matches_per_row_greedy(target):
     assert int(iters) == -(-(10 - 1) // 4)  # ceil((n-1)/(k+1))
 
 
+def test_eos_matches_plain_decode_and_exits_early(target):
+    """EOS semantics equal make_generate_fn's exactly — EOS kept, pads
+    after, per row — for eos ids that fire at different points (or never),
+    with the good and the bad draft; and the loop exits early when every
+    row finishes (iters shrinks vs the no-EOS run)."""
+    prompt = jnp.asarray([[5, 17, 3, 9], [40, 2, 21, 1]], jnp.int32)
+    plain = np.asarray(generate(target, prompt, max_new_tokens=12))
+    # candidate eos ids: tokens the greedy decode actually emits early,
+    # plus one that never appears
+    eos_candidates = [int(plain[0, 0]), int(plain[1, 2]), 46]
+    bad_draft = Model.init(_spec(layers=1, dim=32), seed=99)
+    for eos in eos_candidates:
+        want = np.asarray(generate(target, prompt, max_new_tokens=12,
+                                   eos_id=eos, pad_id=45))
+        for draft in (target, bad_draft):
+            fn = make_speculative_generate_fn(target.spec, draft.spec, 12,
+                                              k=3, eos_id=eos, pad_id=45)
+            got = np.asarray(fn(target.params, draft.params, prompt))
+            np.testing.assert_array_equal(got, want, err_msg=f"eos={eos}")
+
+    # early exit MUST engage: duplicate row 0 so eos = its first emitted
+    # token finishes every row in round 1, and assert the loop really
+    # stopped early (a vacuous <= would pass with early exit broken)
+    both = jnp.asarray(np.stack([np.asarray(prompt[0])] * 2))
+    fn_all = make_speculative_generate_fn(target.spec, target.spec, 12, k=3,
+                                          with_stats=True)
+    _, iters_full = fn_all(target.params, target.params, both)
+    eos_first = int(plain[0, 0])
+    fn_eos = make_speculative_generate_fn(target.spec, target.spec, 12,
+                                          k=3, eos_id=eos_first,
+                                          with_stats=True)
+    toks_eos, iters_eos = fn_eos(target.params, target.params, both)
+    assert int(iters_eos) < int(iters_full), \
+        f"early exit did not engage: {int(iters_eos)} vs {int(iters_full)}"
+    # and the output still matches the plain decoder's EOS semantics
+    want = np.asarray(generate(target, both, max_new_tokens=12,
+                               eos_id=eos_first, pad_id=0))
+    np.testing.assert_array_equal(np.asarray(toks_eos), want)
+
+
 def test_speculative_accept_closed_form():
     """The accept/residual rule in its two analytic corners."""
     import jax
