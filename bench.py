@@ -503,7 +503,10 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     # predictable task (see _train_decode_pair) — acceptance_rate is part
     # of the leg; a random-weights pair would report ~0 acceptance and the
     # number would mean nothing.  k=8/draft 2L-128 from the 2026-07-31
-    # device-time sweep: 29.9k tok/s vs fp_b1's 11.2k (2.66x)
+    # device-time sweep: 29.9k tok/s vs fp_b1's 11.2k (2.66x) with the
+    # XLA draft; the fused Pallas draft step (ops/decode_step.py, auto-
+    # selected at batch 1 for draft-sized models) lifted it to 40.6k
+    # (3.6x) the same day — the leg records which draft step ran
     draft_dim = min(128, model_dim)
     draft_spec = small_lm_spec(vocab_size=vocab, model_dim=draft_dim,
                                num_heads=min(2, num_heads), num_layers=2,
@@ -511,6 +514,12 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     t_params, d_params = _train_decode_pair(spec, draft_spec, vocab,
                                             steps=train_steps)
     k = 8
+    # the SAME resolver the generate fn's auto path runs, so the recorded
+    # label can never drift from the implementation that produced the
+    # number (re-deriving the policy here once dropped the backend gate)
+    from distkeras_tpu.ops.decode_step import resolve_step_impl
+    draft_impl = resolve_step_impl(
+        draft_spec.config, 1, prompt_len + new_tokens + k + 1, None)
     sfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=k,
                                        with_stats=True)
     toks, iters = sfn(t_params, d_params, prompt[:1])
@@ -523,7 +532,7 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     acceptance = min(max(acceptance, 0.0), 1.0)
     out["speculative_b1"] = leg(
         _device_time_ms(sfn, t_params, d_params, prompt[:1], reps=reps),
-        draft_layers=2, draft_dim=draft_dim, k=k,
+        draft_layers=2, draft_dim=draft_dim, k=k, draft_step=draft_impl,
         acceptance_rate=round(float(acceptance), 3), trained=True)
     # the same trained target through the PLAIN decode path: the apples-to-
     # apples denominator for the speculative speedup claim (weights don't
@@ -545,6 +554,8 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     out["speculative_batched"] = leg(
         _device_time_ms(sfn, t_params, d_params, prompt, reps=reps),
         n=batch * new_tokens, draft_layers=2, draft_dim=draft_dim, k=k,
+        draft_step=resolve_step_impl(
+            draft_spec.config, batch, prompt_len + new_tokens + k + 1, None),
         acceptance_rate=round(float(min(max(acc_b, 0.0), 1.0)), 3),
         trained=True)
     # the speedup denominator is the plain batched decode of the SAME

@@ -175,6 +175,49 @@ def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
     return logits, KVCache(k_all, v_all)
 
 
+class FusedStepState(NamedTuple):
+    """Everything the fused Pallas decode step needs beyond the caches:
+    the stacked weight slabs plus the embedding/head params shared with
+    the XLA formulation.  Built once per generate call (loop-invariant —
+    XLA hoists it out of the decode scan)."""
+
+    weights: Any          # ops.decode_step.DecodeWeights
+    embedding: jnp.ndarray  # [V, E] compute dtype (gather side)
+    params: Any           # full tree (final_norm + f32 unembed + pos_embed)
+    config: dict
+    interpret: bool
+
+
+def make_fused_state(params: Any, config: dict) -> FusedStepState:
+    from distkeras_tpu.ops.decode_step import stack_decode_weights
+
+    dtype = _cfg_dtype(config)
+    return FusedStepState(
+        weights=stack_decode_weights(params, config["num_layers"], dtype),
+        embedding=params["embed"]["embedding"].astype(dtype),
+        params=params, config=config,
+        interpret=jax.default_backend() != "tpu")
+
+
+def fused_token_forward(state: FusedStepState, tok: jnp.ndarray, pos,
+                        k_t: jnp.ndarray, v_all: jnp.ndarray):
+    """One fused single-token step + head: [B] tokens at ``pos`` ->
+    (float32 logits [B, 1, V], k_t, v_all).  The head math mirrors
+    ``forward_with_cache`` exactly (f32 final norm stats, f32 unembed)."""
+    from distkeras_tpu.ops.decode_step import fused_decode_step
+
+    config, params = state.config, state.params
+    dtype = _cfg_dtype(config)
+    x = state.embedding[tok] + params["pos_embed"][pos].astype(dtype)
+    hidden, k_t, v_all = fused_decode_step(
+        state.weights, x, k_t, v_all, pos,
+        heads=config["num_heads"], interpret=state.interpret)
+    h = _layer_norm(params["final_norm"], hidden[:, None], dtype)
+    logits = jnp.einsum("ble,ve->blv", h.astype(jnp.float32),
+                        params["embed"]["embedding"].astype(jnp.float32))
+    return logits, k_t, v_all
+
+
 def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int) -> jnp.ndarray:
     """[B, vocab] float32 logits -> [B] int32 token ids."""
     if temperature == 0.0:
@@ -188,7 +231,8 @@ def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int) -> jnp.nda
 def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                      temperature: float = 0.0, top_k: int = 0,
                      eos_id: Optional[int] = None, pad_id: int = 0,
-                     cache_len: Optional[int] = None):
+                     cache_len: Optional[int] = None,
+                     step_impl: Optional[str] = None):
     """Build a jitted ``(params, prompt [B, P], rng) -> tokens [B, max_new]``.
 
     ``cache_len`` defaults to prompt length + ``max_new_tokens`` (it is a
@@ -196,7 +240,17 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     like any jitted shape-polymorphic JAX program).  Greedy when
     ``temperature == 0``.  Rows that have emitted ``eos_id`` keep emitting
     ``pad_id``.
+
+    ``step_impl``: ``None`` auto-selects — the fused Pallas block kernel
+    (``ops/decode_step.py``) on TPU when the shapes support it, the XLA
+    per-op step otherwise.  ``"fused"`` / ``"xla"`` pin the path for A/B
+    measurement (``"fused"`` off-TPU runs the Pallas interpreter — slow,
+    test-only).  Both paths produce the same tokens (parity-tested); the
+    fused step exists because the XLA form pays ~15 ops of fixed sequencing
+    cost per layer per token (see the kernel module docstring).
     """
+    if step_impl not in (None, "fused", "xla"):
+        raise ValueError(f"unknown step_impl {step_impl!r}; use None, 'fused' or 'xla'")
     config = dict(spec.config)
     if config.get("seq_axis") or config.get("tp_axis"):
         raise ValueError("decoding expects a plain (non-sharded) spec; strip "
@@ -207,10 +261,14 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
         raise ValueError(f"decoding is defined for transformer_lm specs, got {spec.name!r}")
     max_seq = config["max_seq_len"]
 
-    @functools.partial(jax.jit, static_argnames=("prompt_len",))
-    def run(params, prompt, rng, prompt_len):
+    @functools.partial(jax.jit, static_argnames=("prompt_len", "impl"))
+    def run(params, prompt, rng, prompt_len, impl):
         params = dequant_embed(params)
         total = cache_len or (prompt_len + max_new_tokens)
+        if impl == "fused":
+            from distkeras_tpu.ops.decode_step import round_cache_len
+
+            total = round_cache_len(total)  # K-slab lane tiling
         if prompt_len + max_new_tokens > total:
             raise ValueError(
                 f"cache_len = {total} cannot hold prompt ({prompt_len}) + "
@@ -228,10 +286,26 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
         # the EOS token itself is kept in the output; rows are padded after
         done = jnp.zeros(prompt.shape[0], bool) if eos_id is None else tok == eos_id
 
+        if impl == "fused":
+            from distkeras_tpu.ops.decode_step import transpose_k_cache
+
+            # loop-invariant w.r.t. the scan: XLA materializes this once
+            # per call, not per token
+            state = make_fused_state(params, config)
+            # the fused kernel wants lane-major keys; transpose ONCE after
+            # prefill (the scan then carries KVCache(k_t, v) — k in
+            # [L, HD, B, S] layout, v unchanged)
+            cache = KVCache(transpose_k_cache(cache.k), cache.v)
+
         def step(carry, _):
             tok, cache, pos, rng, done = carry
-            logits, cache = forward_with_cache(
-                params, config, tok[:, None], pos, cache)
+            if impl == "fused":
+                logits, k_t, v_all = fused_token_forward(
+                    state, tok, pos, cache.k, cache.v)
+                cache = KVCache(k_t, v_all)
+            else:
+                logits, cache = forward_with_cache(
+                    params, config, tok[:, None], pos, cache)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature, top_k)
             if eos_id is not None:
@@ -249,7 +323,15 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     def generate_fn(params, prompt, rng=None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return run(params, prompt, rng, prompt.shape[1])
+        from distkeras_tpu.ops.decode_step import resolve_step_impl
+
+        # auto keys on the MEASURED win region (small models, batch 1 —
+        # see ops.decode_step.fused_step_auto), not just shape support:
+        # the 8-layer/512-dim XLA step is already optimal
+        impl = resolve_step_impl(
+            config, prompt.shape[0],
+            cache_len or (prompt.shape[1] + max_new_tokens), step_impl)
+        return run(params, prompt, rng, prompt.shape[1], impl)
 
     return generate_fn
 
@@ -277,6 +359,16 @@ def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
 
     from distkeras_tpu.parallel.lm import lm_param_specs
 
+    # the fused Pallas step would be an opaque box to GSPMD's sharding
+    # propagation — the whole mechanism this path relies on — so the
+    # sharded program always uses the XLA step (None = auto resolves to
+    # it here; only an explicit 'fused' is an error)
+    if kw.get("step_impl") is None:
+        kw["step_impl"] = "xla"
+    if kw["step_impl"] != "xla":
+        raise ValueError("make_sharded_generate_fn requires step_impl='xla': "
+                         "sharding propagation cannot see through the fused "
+                         "Pallas decode kernel")
     inner = make_generate_fn(spec, max_new_tokens, **kw)  # validates the spec
     for name, axis in (("tp_axis", tp_axis), ("dp_axis", dp_axis)):
         # a typo'd axis must not silently degrade to full replication

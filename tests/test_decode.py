@@ -184,3 +184,89 @@ def test_quantized_tree_decodes_and_matches(model):
     with pytest.raises(ValueError, match="quantized"):
         make_sharded_generate_fn(model.spec, create_nd_mesh((2,), ("tp",)), 4,
                                  tp_axis="tp")(qp, prompt)
+
+
+# --- fused Pallas decode step (ops/decode_step.py) -------------------------
+# CPU runs the kernel through the Pallas interpreter (auto-selected
+# off-TPU), so these pin kernel/XLA parity without hardware; keep the
+# token counts small — interpreted kernels are slow.
+
+
+def _fused_spec(**kw):
+    cfg = dict(vocab_size=97, model_dim=128, num_heads=2, num_layers=2,
+               max_seq_len=64)
+    cfg.update(kw)
+    return small_lm_spec(**cfg)
+
+
+@pytest.fixture(scope="module")
+def fused_model():
+    return Model.init(_fused_spec(), seed=3)
+
+
+def test_fused_step_greedy_parity(fused_model):
+    """The fused block kernel must emit exactly the XLA step's greedy
+    tokens — batch 1 (sublane-padded to 8) and batch 3."""
+    rng = np.random.default_rng(0)
+    for batch in (1, 3):
+        prompt = jnp.asarray(rng.integers(0, 97, (batch, 5)), jnp.int32)
+        want = np.asarray(make_generate_fn(fused_model.spec, 8, step_impl="xla")(
+            fused_model.params, prompt))
+        got = np.asarray(make_generate_fn(fused_model.spec, 8, step_impl="fused")(
+            fused_model.params, prompt))
+        np.testing.assert_array_equal(got, want, err_msg=f"batch={batch}")
+
+
+def test_fused_step_eos_padding_parity(fused_model):
+    """EOS/pad semantics live outside the kernel and must be unaffected:
+    pick an eos id the greedy decode actually emits."""
+    prompt = jnp.asarray([[11, 60, 2]], jnp.int32)
+    plain = np.asarray(make_generate_fn(fused_model.spec, 6, step_impl="xla")(
+        fused_model.params, prompt))
+    eos = int(plain[0, 1])
+    want = np.asarray(make_generate_fn(fused_model.spec, 6, step_impl="xla",
+                                       eos_id=eos, pad_id=7)(
+        fused_model.params, prompt))
+    got = np.asarray(make_generate_fn(fused_model.spec, 6, step_impl="fused",
+                                      eos_id=eos, pad_id=7)(
+        fused_model.params, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_step_int8_tree_parity(fused_model):
+    """QTensor leaves dequantize inside stack_decode_weights: the fused
+    path must match the XLA path run on the SAME quantized tree."""
+    from distkeras_tpu.ops.quantize import quantize_params
+
+    qp = quantize_params(fused_model.params, min_size=64)
+    prompt = jnp.asarray([[40, 8]], jnp.int32)
+    want = np.asarray(make_generate_fn(fused_model.spec, 6, step_impl="xla")(
+        qp, prompt))
+    got = np.asarray(make_generate_fn(fused_model.spec, 6, step_impl="fused")(
+        qp, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_step_cache_len_rounds_up(fused_model):
+    """A cache_len that is not 128-aligned is rounded up inside the fused
+    run (the transposed K slab puts sequence on lanes); dead cache rows
+    are masked, so different cache sizes must decode identically.
+    (Fused-vs-fused on purpose: an xla-vs-fused check here once tripped
+    over a genuine 3e-5 logit near-tie on this random bf16 model —
+    cross-impl float noise, not round-up mechanics.)"""
+    prompt = jnp.asarray([[9, 9, 10]], jnp.int32)
+    want = np.asarray(make_generate_fn(fused_model.spec, 5, step_impl="fused",
+                                       cache_len=256)(fused_model.params, prompt))
+    got = np.asarray(make_generate_fn(fused_model.spec, 5, step_impl="fused",
+                                      cache_len=17)(fused_model.params, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_step_rejects_unsupported_shapes(model):
+    """model_dim 32 is not lane-tiled: explicit step_impl='fused' must
+    fail loudly, and auto-select must silently use the XLA step."""
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    with pytest.raises(ValueError, match="fused"):
+        make_generate_fn(model.spec, 4, step_impl="fused")(model.params, prompt)
+    toks = make_generate_fn(model.spec, 4)(model.params, prompt)  # auto
+    assert np.asarray(toks).shape == (1, 4)

@@ -213,3 +213,36 @@ def test_guards(target):
     with pytest.raises(ValueError, match="max_seq_len"):
         fn(target.params, Model.init(draft, seed=1).params,
            jnp.zeros((1, 60), jnp.int32))
+
+
+def test_fused_draft_steps_match_xla_draft_steps():
+    """The fused Pallas draft path must commit exactly the XLA draft
+    path's tokens (the target verify window is identical either way, so
+    any divergence is a fused-step bug).  Needs a lane-tiled draft —
+    model_dim 128 — and runs the kernel through the Pallas interpreter
+    on CPU."""
+    dspec = _spec(layers=2, dim=128, num_heads=2)
+    tspec = _spec(layers=3, dim=128, num_heads=2)
+    tgt = Model.init(tspec, seed=1)
+    drf = Model.init(dspec, seed=2)
+    prompt = jnp.asarray([[3, 14, 1]], jnp.int32)
+    want = np.asarray(make_speculative_generate_fn(
+        tspec, dspec, 10, k=3, draft_step_impl="xla")(
+        tgt.params, drf.params, prompt))
+    got = np.asarray(make_speculative_generate_fn(
+        tspec, dspec, 10, k=3, draft_step_impl="fused")(
+        tgt.params, drf.params, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_draft_rejects_unsupported_draft_shape(target):
+    """dim-48 drafts are not lane-tiled: explicit 'fused' fails loudly,
+    auto quietly uses the XLA step."""
+    prompt = jnp.asarray([[5, 2]], jnp.int32)
+    with pytest.raises(ValueError, match="fused"):
+        make_speculative_generate_fn(
+            target.spec, target.spec, 6, k=2, draft_step_impl="fused")(
+            target.params, target.params, prompt)
+    toks = make_speculative_generate_fn(target.spec, target.spec, 6, k=2)(
+        target.params, target.params, prompt)
+    assert np.asarray(toks).shape == (1, 6)
