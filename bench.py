@@ -489,15 +489,24 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
 
     out = {"batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens}
 
+    # label every plain-decode leg with the step impl that actually ran
+    # (same resolver as make_generate_fn's auto path): if a config change
+    # ever flips a leg onto the fused kernel, the record says so instead
+    # of silently switching the speedup denominators
+    from distkeras_tpu.ops.decode_step import resolve_step_impl
+    step_b = resolve_step_impl(spec.config, batch, prompt_len + new_tokens, None)
+    step_b1 = resolve_step_impl(spec.config, 1, prompt_len + new_tokens, None)
+
     fn = make_generate_fn(spec, new_tokens)
     out["fp"] = leg(_device_time_ms(fn, model.params, prompt, key, reps=reps),
-                    n=batch * new_tokens)
+                    n=batch * new_tokens, step_impl=step_b)
 
     qparams = quantize_params(model.params)
     out["int8"] = leg(_device_time_ms(fn, qparams, prompt, key, reps=reps),
-                      n=batch * new_tokens)
+                      n=batch * new_tokens, step_impl=step_b)
 
-    out["fp_b1"] = leg(_device_time_ms(fn, model.params, prompt[:1], key, reps=reps))
+    out["fp_b1"] = leg(_device_time_ms(fn, model.params, prompt[:1], key, reps=reps),
+                       step_impl=step_b1)
 
     # speculative leg: TRAINED 8-layer target + small draft on a
     # predictable task (see _train_decode_pair) — acceptance_rate is part
@@ -538,7 +547,7 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     # apples denominator for the speculative speedup claim (weights don't
     # change plain-decode cost, but report it measured, not assumed)
     out["fp_b1_trained"] = leg(_device_time_ms(fn, t_params, prompt[:1], key,
-                                               reps=reps))
+                                               reps=reps), step_impl=step_b1)
     spec_ratio = (out["speculative_b1"]["tokens_per_sec"]
                   / out["fp_b1_trained"]["tokens_per_sec"])
     out["speculative_speedup_vs_fp_b1"] = round(spec_ratio, 3)
@@ -562,7 +571,8 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     # trained weights (like fp_b1_trained for the b1 claim): weight-
     # independence of plain decode cost is measured, never assumed
     out["fp_trained"] = leg(_device_time_ms(fn, t_params, prompt, key,
-                                            reps=reps), n=batch * new_tokens)
+                                            reps=reps), n=batch * new_tokens,
+                            step_impl=step_b)
     out["speculative_speedup_vs_fp_batched"] = round(
         out["speculative_batched"]["tokens_per_sec"]
         / out["fp_trained"]["tokens_per_sec"], 3)

@@ -182,7 +182,10 @@ def fused_step_auto(config: dict, batch: int, cache_len: int) -> bool:
     overrides this for A/B measurement; ``fused_step_supported`` is the
     hard shape gate."""
     e = config["model_dim"]
-    block_bytes = 12 * e * e * config["num_layers"] * 2  # bf16 stream
+    # qkv 3e² + proj e² + up/down 2·mlp_ratio·e² per layer, bf16 stream
+    # (= 12e² at the measured mlp_ratio-4 crossover configs)
+    per_layer = (4 + 2 * config.get("mlp_ratio", 4)) * e * e
+    block_bytes = per_layer * config["num_layers"] * 2
     return (batch == 1 and block_bytes <= _AUTO_MAX_BLOCK_BYTES
             and fused_step_supported(config, batch, cache_len))
 

@@ -78,11 +78,19 @@ class AsyncDistributedTrainer(Trainer):
                  checkpoint_interval: float = 30.0,
                  on_worker_failure: str = "raise",
                  fault_hook: Optional[Callable[[int, int], None]] = None,
+                 compress_commits: Optional[str] = None,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.native_ps = bool(native_ps)
+        # "int8": workers send action-Q commits (4x fewer wire bytes,
+        # error feedback client-side — see PSClient); pulls stay f32.
+        # Both hubs (Python and C++) accept either commit form.
+        if compress_commits not in (None, "int8"):
+            raise ValueError(f"compress_commits must be None or 'int8', "
+                             f"got {compress_commits!r}")
+        self.compress_commits = compress_commits
         # worker-only mode (multi-host): connect to an external hub at this
         # (host, port) instead of starting one; see module docstring
         self.ps_address = tuple(ps_address) if ps_address is not None else None
@@ -202,7 +210,8 @@ class AsyncDistributedTrainer(Trainer):
         def run_worker(idx: int) -> None:
             try:
                 device = devices[idx % len(devices)]
-                client = PSClient(ps_host, ps_port, templates=flat0)
+                client = PSClient(ps_host, ps_port, templates=flat0,
+                                  compress=self.compress_commits)
                 try:
                     shard = dataset.shard(self.num_workers, idx)
                     local_flat = client.pull()

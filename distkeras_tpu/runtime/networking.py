@@ -20,8 +20,19 @@ Wire format (all integers big-endian):
     tensor payload := u8 action, u32 num_tensors,
                       num_tensors * (u64 nbytes, raw bytes)
 
-Actions: ``P`` pull request, ``C`` commit, ``B`` bye,
-``W`` weights reply, ``A`` ack.
+Actions: ``P`` pull request, ``C`` commit, ``Q`` int8-compressed commit,
+``B`` bye, ``W`` weights reply, ``A`` ack.
+
+``Q`` commits carry each tensor as a 4-byte big-endian float32 scale
+followed by the int8-quantized values (symmetric per-tensor:
+``q = round(d / scale)``, ``scale = max|d| / 127``) — 4x fewer wire
+bytes than ``C``.  The hub dequantizes and applies the SAME scaling
+rules as a plain commit; workers keep the quantization residual and add
+it to the next window's delta (error feedback), so the committed sum
+tracks the true delta sum and compression does not bias training (the
+property ``tests/test_runtime.py`` pins).  The reference always shipped
+full-precision pickled weight lists (SURVEY §2.12); this is the
+DCN-bandwidth headroom lever for the genuinely-async PS topology.
 """
 
 from __future__ import annotations
@@ -37,6 +48,7 @@ MAX_FRAME = 1 << 34  # 16 GiB sanity bound on a single frame
 
 ACTION_PULL = b"P"
 ACTION_COMMIT = b"C"
+ACTION_QCOMMIT = b"Q"
 ACTION_BYE = b"B"
 ACTION_WEIGHTS = b"W"
 ACTION_ACK = b"A"
@@ -133,6 +145,30 @@ def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:
     the encoder so senders can pre-flight size limits without duplicating
     the frame layout."""
     return 5 + sum(8 + np.asarray(a).nbytes for a in arrays)
+
+
+# -- int8 commit compression (action Q blobs) ---------------------------------
+
+def quantize_q_blob(delta: np.ndarray) -> Tuple[bytes, np.ndarray]:
+    """One tensor -> (wire blob, float32 quantization residual).
+
+    Blob = big-endian f32 scale + int8 values; residual = what rounding
+    dropped, for the caller's error-feedback accumulator.  An all-zero
+    delta keeps scale 1.0 so dequantization never divides by zero."""
+    d = np.ascontiguousarray(delta, dtype=np.float32)
+    amax = float(np.max(np.abs(d))) if d.size else 0.0
+    scale = amax / 127.0 if amax > 0.0 else 1.0
+    q = np.clip(np.rint(d / scale), -127, 127).astype(np.int8)
+    residual = d - q.astype(np.float32) * np.float32(scale)
+    return struct.pack(">f", scale) + q.tobytes(), residual
+
+
+def dequantize_q_blob(blob: bytes, size: int) -> np.ndarray:
+    """Inverse of :func:`quantize_q_blob`: flat float32 array of ``size``."""
+    if len(blob) != 4 + size:
+        raise ValueError(f"Q blob of {len(blob)} bytes != 4 + {size}")
+    (scale,) = struct.unpack(">f", blob[:4])
+    return np.frombuffer(blob, dtype=np.int8, offset=4).astype(np.float32) * np.float32(scale)
 
 
 def send_tensors(sock: socket.socket, action: bytes, arrays: Sequence[np.ndarray]) -> None:

@@ -102,6 +102,13 @@ class SocketParameterServer:
             out.append(arr.reshape(c.shape))
         return out
 
+    def _decode_qdelta(self, blobs) -> List[np.ndarray]:
+        """int8 commit (action Q): per-tensor f32 scale + int8 values."""
+        if len(blobs) != len(self.center):
+            raise ValueError(f"commit has {len(blobs)} tensors, center has {len(self.center)}")
+        return [net.dequantize_q_blob(np.asarray(blob).tobytes(), c.size).reshape(c.shape)
+                for blob, c in zip(blobs, self.center)]
+
     def _handle_connection(self, conn: socket.socket) -> None:
         last_pull_clock = 0
         try:
@@ -114,8 +121,10 @@ class SocketParameterServer:
                         snapshot = [w.copy() for w in self.center]
                         last_pull_clock = self._clock
                     net.send_tensors(conn, net.ACTION_WEIGHTS, snapshot)
-                elif action == net.ACTION_COMMIT:
-                    delta = self._decode_delta(blobs)
+                elif action in (net.ACTION_COMMIT, net.ACTION_QCOMMIT):
+                    delta = (self._decode_delta(blobs)
+                             if action == net.ACTION_COMMIT
+                             else self._decode_qdelta(blobs))
                     with self._lock:
                         staleness = self._clock - last_pull_clock
                         self.apply_commit(delta, staleness)
@@ -176,11 +185,25 @@ class DynSGDParameterServer(SocketParameterServer):
 
 class PSClient:
     """Worker-side connection: ``pull()`` / ``commit(delta)`` (reference:
-    ``NetworkWorker.pull/commit``, SURVEY §2.10)."""
+    ``NetworkWorker.pull/commit``, SURVEY §2.10).
+
+    ``compress="int8"`` sends commits as action-``Q`` frames — symmetric
+    per-tensor int8 with a float32 scale (4x fewer wire bytes) — and
+    keeps the quantization residual client-side, folding it into the
+    next commit (error feedback: the sum of dequantized commits tracks
+    the sum of true deltas, so compression does not bias the center).
+    Pulls always stay full precision: weight error hits the model
+    directly, while delta rounding error is recycled."""
 
     def __init__(self, host: str, port: int, templates: Sequence[np.ndarray],
-                 timeout: Optional[float] = 60.0):
+                 timeout: Optional[float] = 60.0,
+                 compress: Optional[str] = None):
+        if compress not in (None, "int8"):
+            raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
+        self.compress = compress
+        self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
+                          if compress else None)
         self.sock = net.connect(host, port, timeout=timeout)
 
     def pull(self) -> List[np.ndarray]:
@@ -191,7 +214,25 @@ class PSClient:
         return tensors
 
     def commit(self, delta: Sequence[np.ndarray]) -> None:
-        net.send_tensors(self.sock, net.ACTION_COMMIT, [np.asarray(d, np.float32) for d in delta])
+        if self.compress == "int8":
+            blobs, new_residuals = [], []
+            for i, d in enumerate(delta):
+                carried = np.asarray(d, np.float32) + self._residual[i]
+                blob, res = net.quantize_q_blob(carried)
+                blobs.append(np.frombuffer(blob, dtype=np.uint8))
+                new_residuals.append(res)
+            net.send_tensors(self.sock, net.ACTION_QCOMMIT, blobs)
+            action, _ = net.recv_tensors(self.sock, templates=[])
+            if action != net.ACTION_ACK:
+                raise ConnectionError(f"expected ack, got {action!r}")
+            # only a DELIVERED commit sheds its carried delta: updating the
+            # residual before the ack would lose a whole window's worth of
+            # update on a failed send, breaking the error-feedback
+            # invariant for callers that reconnect and retry
+            self._residual = new_residuals
+            return
+        net.send_tensors(self.sock, net.ACTION_COMMIT,
+                         [np.asarray(d, np.float32) for d in delta])
         action, _ = net.recv_tensors(self.sock, templates=[])
         if action != net.ACTION_ACK:
             raise ConnectionError(f"expected ack, got {action!r}")

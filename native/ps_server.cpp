@@ -15,6 +15,8 @@
 //                     num_tensors * (u64 nbytes, raw bytes)
 //   actions: 'P' pull -> 'W' + center tensors
 //            'C' commit (center-shaped f32 deltas) -> 'A'
+//            'Q' int8 commit (per tensor: be f32 scale + int8 values,
+//                dequantized here, then the same scaling rules) -> 'A'
 //            'B' bye -> connection closes
 //
 // Commit scaling modes (matching runtime/parameter_server.py):
@@ -216,6 +218,38 @@ class ParameterServer {
     return off == payload.size();
   }
 
+  // parse an int8 commit (action 'Q'): each tensor blob is a big-endian
+  // f32 scale + int8 values; dequantize into qbuf (reused per
+  // connection) and point delta_out at the float rows — identical math
+  // to the Python hub's _decode_qdelta, so both hubs accept one client
+  bool parse_qcommit(const std::vector<unsigned char>& payload,
+                     std::vector<float>& qbuf, const float** delta_out) {
+    if (payload.size() < 5) return false;
+    uint32_t count = be32_decode(payload.data() + 1);
+    if (count != sizes_.size()) return false;
+    int64_t total = 0;
+    for (int64_t s : sizes_) total += s;
+    qbuf.resize(size_t(total));
+    float* dst = qbuf.data();
+    size_t off = 5;
+    for (uint32_t i = 0; i < count; ++i) {
+      if (off + 8 > payload.size()) return false;
+      uint64_t nbytes = be64_decode(payload.data() + off);
+      off += 8;
+      if (nbytes != 4 + uint64_t(sizes_[i])) return false;
+      if (off + nbytes > payload.size()) return false;
+      uint32_t scale_be = be32_decode(payload.data() + off);
+      float scale;
+      std::memcpy(&scale, &scale_be, sizeof(scale));
+      const auto* q = reinterpret_cast<const signed char*>(payload.data() + off + 4);
+      delta_out[i] = dst;
+      for (int64_t j = 0; j < sizes_[i]; ++j) dst[j] = float(q[j]) * scale;
+      dst += sizes_[i];
+      off += nbytes;
+    }
+    return off == payload.size();
+  }
+
   void apply_commit(const float** delta, int64_t staleness) {
     float scale = 1.0f;
     if (mode_ == 1) scale = 1.0f / float(num_workers_);
@@ -233,6 +267,7 @@ class ParameterServer {
     int64_t last_pull_clock = 0;
     std::vector<unsigned char> payload;
     std::vector<const float*> delta(sizes_.size());
+    std::vector<float> qbuf;
     std::vector<float> snap;
     while (running_.load()) {
       if (!recv_payload(fd, payload) || payload.empty()) break;
@@ -247,8 +282,9 @@ class ParameterServer {
           snap = center_;
         }
         if (!send_weights(fd, snap)) break;
-      } else if (action == 'C') {
-        if (!parse_commit(payload, delta.data())) break;
+      } else if (action == 'C' || action == 'Q') {
+        if (action == 'C' ? !parse_commit(payload, delta.data())
+                          : !parse_qcommit(payload, qbuf, delta.data())) break;
         {
           std::lock_guard<std::mutex> g(center_mutex_);
           apply_commit(delta.data(), clock_ - last_pull_clock);

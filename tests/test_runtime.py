@@ -232,3 +232,82 @@ def test_fault_injection_continue_and_raise(toy_dataset):
     assert "injected fault" in str(t2.worker_errors[0])
     assert len(t2.history) > 0  # worker 0 trained through both epochs
     assert model.predict(toy_dataset["features"][:8]).shape == (8, 2)
+
+
+def test_q_blob_roundtrip_and_error_feedback():
+    """quantize/dequantize inverts within scale/2 per element, and the
+    client-side error-feedback accumulator makes the SUM of dequantized
+    commits track the sum of true deltas (compression is unbiased over
+    time — the property that lets int8 commits train)."""
+    from distkeras_tpu.runtime.networking import (dequantize_q_blob,
+                                                  quantize_q_blob)
+
+    rng = np.random.default_rng(0)
+    d = rng.normal(size=(64,)).astype(np.float32)
+    blob, residual = quantize_q_blob(d)
+    back = dequantize_q_blob(blob, 64)
+    scale = np.frombuffer(blob[:4], ">f4")[0]
+    assert np.abs(back - d).max() <= scale / 2 + 1e-7
+    np.testing.assert_allclose(back + residual, d, rtol=0, atol=1e-6)
+
+    # zero delta: scale stays 1.0, nothing divides by zero
+    zb, zr = quantize_q_blob(np.zeros(8, np.float32))
+    assert np.all(dequantize_q_blob(zb, 8) == 0) and np.all(zr == 0)
+
+    # error feedback across a stream of commits
+    true_sum = np.zeros(64, np.float32)
+    wire_sum = np.zeros(64, np.float32)
+    carry = np.zeros(64, np.float32)
+    for step in range(50):
+        d = rng.normal(size=(64,)).astype(np.float32) * 0.01
+        true_sum += d
+        blob, carry = quantize_q_blob(d + carry)
+        wire_sum += dequantize_q_blob(blob, 64)
+    # the residual is all that separates the sums, and it is bounded by
+    # one quantum — NOT growing with the number of commits
+    np.testing.assert_allclose(wire_sum, true_sum, atol=5e-3)
+
+
+def test_int8_commits_land_like_f32_commits():
+    """An int8-compressed commit of exactly-representable deltas must move
+    the Python hub's center exactly like the f32 commit (ADAG scaling
+    applies AFTER dequantization, on the hub)."""
+    ps = ADAGParameterServer(_weights(), num_workers=4)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=_weights(),
+                      compress="int8") as c:
+            # max|d| = 127 makes the scale exactly 1.0: quantization is
+            # lossless here, isolating the wire path from rounding
+            c.commit([np.full((2, 2), 127.0, np.float32),
+                      np.full((3,), 127.0, np.float32)])
+            w = c.pull()
+            np.testing.assert_allclose(w[0], np.full((2, 2), 127.0 / 4))
+            np.testing.assert_allclose(w[1], np.full((3,), 127.0 / 4))
+        assert ps.num_updates == 1
+    finally:
+        ps.stop()
+
+
+def test_compressed_async_trainer_learns(toy_dataset):
+    """AsyncDOWNPOUR with compress_commits='int8' reaches the same toy
+    accuracy as uncompressed — error feedback keeps training unbiased."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.data.transformers import LabelIndexTransformer
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.predictors import ModelPredictor
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+    trainer = dk.AsyncDOWNPOUR(
+        Model.init(spec, seed=0), loss="categorical_crossentropy",
+        batch_size=16, num_epoch=2, num_workers=4, communication_window=4,
+        learning_rate=0.05, seed=0, compress_commits="int8")
+    model = trainer.train(toy_dataset)
+    assert trainer.parameter_server.num_updates > 0
+    ds = ModelPredictor(model, features_col="features").predict(toy_dataset)
+    ds = LabelIndexTransformer().transform(ds)
+    acc = AccuracyEvaluator(prediction_col="prediction_index",
+                            label_col="label_index").evaluate(ds)
+    assert acc > 0.9, f"int8-commit training underperformed: {acc}"
