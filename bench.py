@@ -523,10 +523,9 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     t_params, d_params = _train_decode_pair(spec, draft_spec, vocab,
                                             steps=train_steps)
     k = 8
-    # the SAME resolver the generate fn's auto path runs, so the recorded
-    # label can never drift from the implementation that produced the
-    # number (re-deriving the policy here once dropped the backend gate)
-    from distkeras_tpu.ops.decode_step import resolve_step_impl
+    # the SAME resolver the generate fn's auto path runs (imported above),
+    # so the recorded label can never drift from the implementation that
+    # produced the number
     draft_impl = resolve_step_impl(
         draft_spec.config, 1, prompt_len + new_tokens + k + 1, None)
     sfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=k,

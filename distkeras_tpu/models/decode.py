@@ -218,18 +218,41 @@ def fused_token_forward(state: FusedStepState, tok: jnp.ndarray, pos,
     return logits, k_t, v_all
 
 
-def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int) -> jnp.ndarray:
-    """[B, vocab] float32 logits -> [B] int32 token ids."""
+def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int,
+            top_p: float = 0.0) -> jnp.ndarray:
+    """[B, vocab] float32 logits -> [B] int32 token ids.
+
+    ``top_k`` keeps the k highest logits; ``top_p`` (nucleus sampling,
+    Holtzman et al. 2019) keeps the smallest set of tokens whose
+    temperature-scaled probabilities sum to >= top_p — the filters
+    compose (k first, then p) and both are no-ops at their 0 defaults.
+    The nucleus always contains the argmax, so top_p -> 0 degrades to
+    greedy, not to an empty support."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if top_k:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, float("-inf"), logits)
+    if top_p and top_p < 1.0:
+        probs = jax.nn.softmax(logits / temperature, axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        # a token stays iff the mass BEFORE it (exclusive) is < top_p; the
+        # exclusive form keeps the top-1 token unconditionally.  The mask
+        # maps back through the inverse permutation (NOT a probability
+        # threshold, which would re-admit every token tied with the
+        # boundary and make top_p a no-op on tied distributions)
+        keep_sorted = (cum - sorted_probs) < top_p
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, float("-inf"))
     return jax.random.categorical(rng, logits / temperature, axis=-1).astype(jnp.int32)
 
 
 def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                      temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 0.0,
                      eos_id: Optional[int] = None, pad_id: int = 0,
                      cache_len: Optional[int] = None,
                      step_impl: Optional[str] = None):
@@ -238,8 +261,9 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     ``cache_len`` defaults to prompt length + ``max_new_tokens`` (it is a
     static shape, so the returned fn recompiles per distinct prompt length,
     like any jitted shape-polymorphic JAX program).  Greedy when
-    ``temperature == 0``.  Rows that have emitted ``eos_id`` keep emitting
-    ``pad_id``.
+    ``temperature == 0``; ``top_k``/``top_p`` (nucleus) filter the sampled
+    distribution (see ``_sample``).  Rows that have emitted ``eos_id``
+    keep emitting ``pad_id``.
 
     ``step_impl``: ``None`` auto-selects — the fused Pallas block kernel
     (``ops/decode_step.py``) on TPU when the shapes support it, the XLA
@@ -282,7 +306,7 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
         logits, cache = forward_with_cache(params, config, prompt, 0, cache,
                                            last_only=True)
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature, top_k)
+        tok = _sample(logits[:, -1], sub, temperature, top_k, top_p)
         # the EOS token itself is kept in the output; rows are padded after
         done = jnp.zeros(prompt.shape[0], bool) if eos_id is None else tok == eos_id
 
@@ -307,7 +331,7 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                 logits, cache = forward_with_cache(
                     params, config, tok[:, None], pos, cache)
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
             if eos_id is not None:
                 nxt = jnp.where(done, pad_id, nxt)
                 done = done | (nxt == eos_id)
@@ -402,7 +426,7 @@ def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
 
 
 def generate(model: Model, prompt: jnp.ndarray, max_new_tokens: int,
-             *, temperature: float = 0.0, top_k: int = 0,
+             *, temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: Optional[int] = None, pad_id: int = 0,
              seed: int = 0) -> jnp.ndarray:
     """Convenience one-shot: generate ``max_new_tokens`` continuations of
@@ -412,5 +436,5 @@ def generate(model: Model, prompt: jnp.ndarray, max_new_tokens: int,
     (this wrapper rebuilds — and therefore recompiles — every call).
     """
     fn = make_generate_fn(model.spec, max_new_tokens, temperature=temperature,
-                          top_k=top_k, eos_id=eos_id, pad_id=pad_id)
+                          top_k=top_k, top_p=top_p, eos_id=eos_id, pad_id=pad_id)
     return fn(model.params, jnp.asarray(prompt), jax.random.PRNGKey(seed))

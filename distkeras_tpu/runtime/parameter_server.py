@@ -214,28 +214,27 @@ class PSClient:
         return tensors
 
     def commit(self, delta: Sequence[np.ndarray]) -> None:
+        new_residuals = None
         if self.compress == "int8":
-            blobs, new_residuals = [], []
+            action, arrays, new_residuals = net.ACTION_QCOMMIT, [], []
             for i, d in enumerate(delta):
                 carried = np.asarray(d, np.float32) + self._residual[i]
                 blob, res = net.quantize_q_blob(carried)
-                blobs.append(np.frombuffer(blob, dtype=np.uint8))
+                arrays.append(np.frombuffer(blob, dtype=np.uint8))
                 new_residuals.append(res)
-            net.send_tensors(self.sock, net.ACTION_QCOMMIT, blobs)
-            action, _ = net.recv_tensors(self.sock, templates=[])
-            if action != net.ACTION_ACK:
-                raise ConnectionError(f"expected ack, got {action!r}")
+        else:
+            action = net.ACTION_COMMIT
+            arrays = [np.asarray(d, np.float32) for d in delta]
+        net.send_tensors(self.sock, action, arrays)
+        reply, _ = net.recv_tensors(self.sock, templates=[])
+        if reply != net.ACTION_ACK:
+            raise ConnectionError(f"expected ack, got {reply!r}")
+        if new_residuals is not None:
             # only a DELIVERED commit sheds its carried delta: updating the
             # residual before the ack would lose a whole window's worth of
             # update on a failed send, breaking the error-feedback
             # invariant for callers that reconnect and retry
             self._residual = new_residuals
-            return
-        net.send_tensors(self.sock, net.ACTION_COMMIT,
-                         [np.asarray(d, np.float32) for d in delta])
-        action, _ = net.recv_tensors(self.sock, templates=[])
-        if action != net.ACTION_ACK:
-            raise ConnectionError(f"expected ack, got {action!r}")
 
     def close(self) -> None:
         try:

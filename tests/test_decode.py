@@ -270,3 +270,43 @@ def test_fused_step_rejects_unsupported_shapes(model):
         make_generate_fn(model.spec, 4, step_impl="fused")(model.params, prompt)
     toks = make_generate_fn(model.spec, 4)(model.params, prompt)  # auto
     assert np.asarray(toks).shape == (1, 4)
+
+
+# --- nucleus (top-p) sampling ----------------------------------------------
+
+
+def test_top_p_restricts_support_and_keeps_argmax():
+    """Direct _sample checks on a hand-built distribution: the nucleus
+    contains exactly the smallest prefix of sorted probs reaching top_p,
+    and a tiny top_p degrades to greedy."""
+    from distkeras_tpu.models.decode import _sample
+
+    # probs ~ [0.5, 0.25, 0.15, 0.1]: top_p=0.6 keeps {0, 1} (0.5 < 0.6,
+    # exclusive-prefix rule), top_p=0.76 keeps {0, 1, 2}
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]], jnp.float32))
+    seen = {int(_sample(logits, jax.random.PRNGKey(s), 1.0, 0, 0.6)[0])
+            for s in range(200)}
+    assert seen == {0, 1}, seen
+    seen = {int(_sample(logits, jax.random.PRNGKey(s), 1.0, 0, 0.76)[0])
+            for s in range(400)}
+    assert seen == {0, 1, 2}, seen
+    # nucleus always contains the argmax: top_p -> 0 is greedy
+    assert all(int(_sample(logits, jax.random.PRNGKey(s), 1.0, 0, 1e-6)[0]) == 0
+               for s in range(20))
+    # ties at the nucleus boundary must NOT re-admit every tied token (a
+    # probability-threshold cut would keep all 4): uniform probs with
+    # top_p=0.3 keep exactly the 2-token prefix whose mass reaches 0.3
+    tied = jnp.zeros((1, 4), jnp.float32)
+    seen = {int(_sample(tied, jax.random.PRNGKey(s), 1.0, 0, 0.3)[0])
+            for s in range(100)}
+    assert len(seen) == 2, seen
+
+
+def test_generate_with_top_p_reproducible_and_in_range(model):
+    toks1 = generate(model, jnp.asarray([[3, 7]], jnp.int32), 8,
+                     temperature=0.8, top_p=0.9, seed=5)
+    toks2 = generate(model, jnp.asarray([[3, 7]], jnp.int32), 8,
+                     temperature=0.8, top_p=0.9, seed=5)
+    np.testing.assert_array_equal(np.asarray(toks1), np.asarray(toks2))
+    a = np.asarray(toks1)
+    assert a.shape == (1, 8) and ((a >= 0) & (a < 61)).all()
