@@ -617,8 +617,9 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
                 head_dim: int = 64, steps: int = 30):
     """Ring-attention PER-BLOCK compute: flash kernel vs dense XLA on one
     [B, l_local, H, D] block, fwd+bwd — the measurement behind
-    ``ring_attention``'s auto-select threshold (``ops/attention.py``:
-    flash per-block at l_local >= 2048, dense below).  Round-3 verdict
+    ``ring_attention``'s auto-select threshold (``ops/attention.py ::
+    ring_block_impl``: flash when l_local * head_dim >= 2048 * 64 —
+    the crossover tracks per-block work, not length).  Round-3 verdict
     task 4: these crossover numbers lived only in a docstring with no
     tripwire; now they are bench legs with ``vs_baseline``, so threshold
     drift after a kernel change trips visibly.
@@ -678,7 +679,7 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
         # what ring_attention actually auto-selects for this shard length
         # (shared predicate — restating the threshold here would hide the
         # drift this leg exists to catch)
-        "auto_selects": ring_block_impl(l_local),
+        "auto_selects": ring_block_impl(l_local, head_dim),
     }
 
 

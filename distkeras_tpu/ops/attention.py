@@ -46,14 +46,20 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool
     return out
 
 
-def ring_block_impl(l_local: int) -> str:
+def ring_block_impl(l_local: int, head_dim: int) -> str:
     """The per-block compute ``ring_attention`` auto-selects for a shard of
-    ``l_local`` positions on TPU: the flash kernel wins at l_local >= 2048
-    (device-time crossover, see ``ring_attention`` docstring; tracked by
-    ``bench.py``'s ``ring`` legs) and needs Mosaic-legal 128-divisible
-    blocks; dense-XLA otherwise.  Single source for the threshold — the
-    bench imports this instead of restating the rule."""
-    return ("flash" if (jax.default_backend() == "tpu" and l_local >= 2048
+    ``l_local`` positions on TPU; dense-XLA below the crossover, the flash
+    kernel above it (which also needs Mosaic-legal 128-divisible blocks).
+
+    The crossover tracks per-block WORK, not length alone — v5e
+    device-time measurements (fwd+bwd per block; bench ``ring`` legs
+    track the hd-64 row): head_dim 64 flash/dense = 0.79x at l_local
+    1024, 4.0x at 2048; head_dim 128 = 0.72x at 512, 1.05x at 1024,
+    2.29x at 2048.  Both cross between 65k and 131k of l_local*head_dim,
+    so the rule is area >= 2048*64.  Single source for the threshold —
+    the bench imports this instead of restating it."""
+    return ("flash" if (jax.default_backend() == "tpu"
+                        and l_local * head_dim >= 2048 * 64
                         and l_local % 128 == 0)
             else "dense")
 
@@ -97,7 +103,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: st
     my = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
     if impl is None:
-        use_flash = ring_block_impl(l_local) == "flash"
+        use_flash = ring_block_impl(l_local, d) == "flash"
     elif impl in ("flash", "dense"):
         use_flash = impl == "flash"
     else:
@@ -213,7 +219,13 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
             # rule additionally required B*L >= 16k tokens — that cutoff
             # was an artifact of WALL timing (relay dispatch noise on
             # small, fast steps); it cost the head_dim-128 LM legs 30-44%
-            # (e.g. the 1024-dim leg: dense 126.8 ms/step vs flash 88.1)
+            # (e.g. the 1024-dim leg: dense 126.8 ms/step vs flash 88.1).
+            # Deliberately LENGTH-only, unlike ring_block_impl's area
+            # rule: below 2048 the winner here flips with batch as well
+            # (L=1024 device sweep: 0.77x at b2/hd64 but 2.09x at
+            # b8/hd64; 0.92x at b2/hd128, 1.12x at b8/hd128), so there
+            # is no clean sub-2048 predicate — the length rule is the
+            # measured safe-everywhere region
             impl = ("flash" if (jax.default_backend() == "tpu"
                                 and q.shape[1] >= 2048
                                 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)

@@ -110,3 +110,20 @@ def test_ring_gradients_match_dense():
     for a, b, name in zip(gr, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
                                    err_msg=f"ring/dense grad mismatch for {name}")
+
+
+def test_ring_block_impl_area_rule(monkeypatch):
+    """The flash/dense auto-select crossover tracks per-block WORK
+    (l_local * head_dim >= 2048*64, measured on v5e at head_dim 64 and
+    128 — see the docstring), is TPU-only, and requires 128-divisible
+    block lengths."""
+    from distkeras_tpu.ops import attention as att
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    assert att.ring_block_impl(2048, 64) == "flash"
+    assert att.ring_block_impl(1024, 64) == "dense"   # 0.79x measured
+    assert att.ring_block_impl(1024, 128) == "flash"  # 1.05x measured
+    assert att.ring_block_impl(512, 128) == "dense"   # 0.72x measured
+    assert att.ring_block_impl(2050, 64) == "dense"   # not 128-divisible
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "cpu")
+    assert att.ring_block_impl(4096, 128) == "dense"  # interpret mode is slow
