@@ -20,7 +20,7 @@ def _parse_single_json_line(capsys):
 
 def test_main_emits_metric_line(capsys, monkeypatch):
     monkeypatch.setattr(bench, "_bench_mnist_cnn",
-                        lambda **kw: 123.4)
+                        lambda **kw: (123.4, bench._METHODOLOGY))
     bench.main()
     rec = _parse_single_json_line(capsys)
     assert rec["metric"] == "mnist_cnn_train_samples_per_sec_per_chip"
@@ -43,8 +43,11 @@ def test_main_emits_diagnostic_line_on_failure(capsys, monkeypatch):
 
 
 def test_mnist_bench_runs_on_cpu():
-    sps = bench._bench_mnist_cnn(batch_size=8, num_batches=2, reps=1)
+    sps, method = bench._bench_mnist_cnn(batch_size=8, num_batches=2, reps=1)
     assert sps > 0
+    # the profiler trace has no device module events on CPU: the tag must
+    # say WALL so the ratio logic refuses a device-keyed baseline
+    assert method == bench._METHODOLOGY_WALL
 
 
 def test_peak_flops_lookup():
