@@ -62,6 +62,8 @@ _EXPORTS = {
     "generate": "distkeras_tpu.models.decode",
     "make_generate_fn": "distkeras_tpu.models.decode",
     "make_speculative_generate_fn": "distkeras_tpu.models.speculative",
+    "beam_search": "distkeras_tpu.models.beam",
+    "make_beam_search_fn": "distkeras_tpu.models.beam",
     "ModelPredictor": "distkeras_tpu.predictors",
     "AccuracyEvaluator": "distkeras_tpu.evaluators",
     "pin_cpu_devices": "distkeras_tpu.platform",

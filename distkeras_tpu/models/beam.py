@@ -1,0 +1,171 @@
+"""Beam-search decoding for ``TransformerLM``.
+
+No reference counterpart (the reference predates LMs; SURVEY.md §2.21) —
+this completes the serving family next to greedy/sampled decoding
+(``models/decode.py``) and speculative drafting (``models/speculative.py``).
+
+Compiler-first shape: the whole search is one jitted program — a prefill
+on the true batch, the KV cache tiled to ``B*W`` rows, then a
+``lax.scan`` of fixed-shape steps.  Each step scores all ``W*V``
+continuations per batch row with one ``top_k``, reorders the cache and
+the token history by the surviving beams' parent indices
+(``jnp.take`` along the batch axis — the classic beam-search cache
+shuffle), and appends the chosen tokens.  No dynamic shapes anywhere;
+finished beams are masked, not removed:
+
+- a beam that has emitted ``eos_id`` only ever extends with ``pad_id``
+  at zero additional score (every other token is -inf), so its final
+  score is frozen while live beams keep competing;
+- the EOS token itself is kept, pads follow — the same output
+  convention as ``make_generate_fn``.
+
+Scores are sums of f32 ``log_softmax`` token logprobs under the target.
+``length_penalty`` alpha > 0 applies the GNMT normalization
+``score / ((5 + len) / 6)**alpha`` at the FINAL beam selection only
+(len = tokens before padding), the standard way to stop beam search
+favoring short EOS-terminated hypotheses; 0 disables it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.base import Model, ModelSpec
+from distkeras_tpu.models.decode import (KVCache, dequant_embed,
+                                         forward_with_cache, init_cache,
+                                         validate_decode_spec)
+
+_NEG_INF = jnp.float32(-1e30)  # finite: -inf - -inf = nan would poison scores
+
+
+def make_beam_search_fn(spec: ModelSpec, max_new_tokens: int, *,
+                        beam_width: int = 4, length_penalty: float = 0.0,
+                        eos_id: Optional[int] = None, pad_id: int = 0,
+                        cache_len: Optional[int] = None,
+                        return_all: bool = False):
+    """Build a jitted ``(params, prompt [B, P]) -> (tokens, scores)``.
+
+    Default: the best beam per row — tokens [B, max_new_tokens], scores
+    [B] (f32 total logprob; length-normalized iff ``length_penalty`` >
+    0).  ``return_all=True`` returns every beam, best first: tokens
+    [B, W, max_new_tokens], scores [B, W].
+
+    ``beam_width=1`` IS greedy decoding (equality with
+    ``make_generate_fn(temperature=0)`` is test-pinned).
+    """
+    config = validate_decode_spec(spec, "beam search")
+    if not 1 <= beam_width <= config["vocab_size"]:
+        raise ValueError(f"beam_width must be in [1, vocab_size="
+                         f"{config['vocab_size']}], got {beam_width}")
+    if eos_id is not None and not 0 <= eos_id < config["vocab_size"]:
+        raise ValueError(f"eos_id {eos_id} outside vocab "
+                         f"[0, {config['vocab_size']})")
+    if not 0 <= pad_id < config["vocab_size"]:
+        raise ValueError(f"pad_id {pad_id} outside vocab "
+                         f"[0, {config['vocab_size']}) — an out-of-range pad "
+                         "would be silently clamped by the frozen-row scatter")
+    max_seq = config["max_seq_len"]
+    w = beam_width
+    vocab = config["vocab_size"]
+
+    @functools.partial(jax.jit, static_argnames=("prompt_len",))
+    def run(params, prompt, prompt_len):
+        n = max_new_tokens
+        b = prompt.shape[0]
+        total = cache_len or (prompt_len + n)
+        if prompt_len + n > total:
+            raise ValueError(f"cache_len = {total} cannot hold prompt "
+                             f"({prompt_len}) + max_new_tokens ({n})")
+        if prompt_len + n > max_seq:
+            raise ValueError(f"prompt ({prompt_len}) + max_new_tokens ({n}) "
+                             f"exceeds max_seq_len = {max_seq}")
+        params = dequant_embed(params)
+        cache = init_cache(config, b, total)
+        logits, cache = forward_with_cache(params, config, prompt, 0, cache,
+                                           last_only=True)
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+
+        # first expansion: top-W distinct first tokens seed the beams
+        scores, tok0 = lax.top_k(logp0, w)                  # [B, W] both
+        tok0 = tok0.astype(jnp.int32)
+        done = (jnp.zeros((b, w), bool) if eos_id is None else tok0 == eos_id)
+        # beam-major layout: flat row b*W + w holds batch b's w-th beam
+        cache = KVCache(jnp.repeat(cache.k, w, axis=1),
+                        jnp.repeat(cache.v, w, axis=1))
+        history = jnp.full((b, w, n), pad_id, jnp.int32)
+        history = history.at[:, :, 0].set(tok0)
+
+        # a finished beam's only continuation: pad at zero added score
+        frozen_row = jnp.full((vocab,), _NEG_INF).at[pad_id].set(0.0)
+
+        lengths = jnp.ones((b, w), jnp.float32)  # scored tokens per beam
+
+        def step(carry, t):
+            cache, cur, scores, history, done, lengths = carry
+            logits, cache = forward_with_cache(
+                params, config, cur.reshape(b * w)[:, None],
+                prompt_len + t, cache)
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32)).reshape(b, w, vocab)
+            logp = jnp.where(done[:, :, None], frozen_row[None, None], logp)
+            cand = scores[:, :, None] + logp                # [B, W, V]
+            scores, flat = lax.top_k(cand.reshape(b, w * vocab), w)
+            parent = flat // vocab                          # [B, W]
+            tok = (flat % vocab).astype(jnp.int32)
+
+            # reorder every per-beam carry by the surviving parents
+            take = jnp.take_along_axis
+            history = take(history, parent[:, :, None], axis=1)
+            history = history.at[:, :, t + 1].set(tok)
+            done = take(done, parent, axis=1)
+            # the new token is a scored part of the hypothesis unless its
+            # beam had already finished (then it is a frozen pad).  This
+            # is the exact GNMT length — counting non-pad history tokens
+            # would miscount when pad_id appears as a genuine token
+            lengths = take(lengths, parent, axis=1) + (~done).astype(jnp.float32)
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+            flat_parent = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
+            cache = KVCache(jnp.take(cache.k, flat_parent, axis=1),
+                            jnp.take(cache.v, flat_parent, axis=1))
+            return (cache, tok, scores, history, done, lengths), None
+
+        if n > 1:
+            (cache, _, scores, history, done, lengths), _ = lax.scan(
+                step, (cache, tok0, scores, history, done, lengths),
+                jnp.arange(n - 1))
+
+        # final ranking (length-normalized iff requested)
+        if length_penalty > 0.0:
+            ranked = scores / ((5.0 + lengths) / 6.0) ** length_penalty
+        else:
+            ranked = scores
+        order = jnp.argsort(-ranked, axis=1)
+        history = jnp.take_along_axis(history, order[:, :, None], axis=1)
+        ranked = jnp.take_along_axis(ranked, order, axis=1)
+        if return_all:
+            return history, ranked
+        return history[:, 0], ranked[:, 0]
+
+    def beam_fn(params, prompt):
+        prompt = jnp.asarray(prompt)
+        return run(params, prompt, prompt.shape[1])
+
+    return beam_fn
+
+
+def beam_search(model: Model, prompt, max_new_tokens: int, *,
+                beam_width: int = 4, length_penalty: float = 0.0,
+                eos_id: Optional[int] = None, pad_id: int = 0) -> Tuple:
+    """Convenience one-shot wrapper (rebuilds + recompiles per call; for
+    repeated use build once with :func:`make_beam_search_fn`)."""
+    fn = make_beam_search_fn(model.spec, max_new_tokens,
+                             beam_width=beam_width,
+                             length_penalty=length_penalty,
+                             eos_id=eos_id, pad_id=pad_id)
+    return fn(model.params, jnp.asarray(prompt))

@@ -86,6 +86,22 @@ def _cfg_dtype(config: dict) -> Any:
     return config.get("compute_dtype", jnp.bfloat16)
 
 
+def validate_decode_spec(spec: ModelSpec, what: str = "decoding") -> dict:
+    """Shared precondition gate for the whole decoder family (plain
+    generate, speculative target/draft, beam search): KV-cache math is
+    single-program transformer_lm only.  Returns a config copy."""
+    config = dict(spec.config)
+    if config.get("seq_axis") or config.get("tp_axis"):
+        raise ValueError(f"{what} expects a plain (non-sharded) spec; strip "
+                         "seq_axis/tp_axis — the cache math is single-program")
+    if config.get("moe_experts"):
+        raise ValueError(f"KV-cache {what} does not support MoE specs (v1)")
+    if spec.name != "transformer_lm":
+        raise ValueError(f"{what} is defined for transformer_lm specs, "
+                         f"got {spec.name!r}")
+    return config
+
+
 def _layer_norm(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
     """flax.linen.LayerNorm semantics: stats in float32, eps 1e-6."""
     xf = x.astype(jnp.float32)
@@ -275,14 +291,7 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     """
     if step_impl not in (None, "fused", "xla"):
         raise ValueError(f"unknown step_impl {step_impl!r}; use None, 'fused' or 'xla'")
-    config = dict(spec.config)
-    if config.get("seq_axis") or config.get("tp_axis"):
-        raise ValueError("decoding expects a plain (non-sharded) spec; strip "
-                         "seq_axis/tp_axis — the cache math is single-program")
-    if config.get("moe_experts"):
-        raise ValueError("KV-cache decoding does not support MoE specs (v1)")
-    if spec.name != "transformer_lm":
-        raise ValueError(f"decoding is defined for transformer_lm specs, got {spec.name!r}")
+    config = validate_decode_spec(spec, "decoding")
     max_seq = config["max_seq_len"]
 
     @functools.partial(jax.jit, static_argnames=("prompt_len", "impl"))

@@ -159,15 +159,10 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     exit fewer tokens are committed, so that formula UNDERSTATES nothing
     but the benchmarks run without EOS.)
     """
-    t_cfg, d_cfg = dict(target_spec.config), dict(draft_spec.config)
-    for name, spec in (("target", target_spec), ("draft", draft_spec)):
-        if spec.name != "transformer_lm":
-            raise ValueError(f"{name} spec must be transformer_lm, got {spec.name!r}")
-        if spec.config.get("seq_axis") or spec.config.get("tp_axis"):
-            raise ValueError(f"{name} spec must be plain (non-sharded)")
-        if spec.config.get("moe_experts"):
-            raise ValueError(f"KV-cache decoding does not support MoE specs "
-                             f"(v1); {name} spec has moe_experts set")
+    from distkeras_tpu.models.decode import validate_decode_spec
+
+    t_cfg = validate_decode_spec(target_spec, "target decoding")
+    d_cfg = validate_decode_spec(draft_spec, "draft decoding")
     if t_cfg["vocab_size"] != d_cfg["vocab_size"]:
         raise ValueError(f"vocab mismatch: target {t_cfg['vocab_size']} vs "
                          f"draft {d_cfg['vocab_size']}")
