@@ -68,9 +68,13 @@ def main() -> None:
     print(f"devices: {len(devices)} x {devices[0].device_kind}")
     dp = len(devices)
 
+    # position budget: training needs seq_len; generation needs the
+    # prompt half + sample_len, and the speculative demo additionally
+    # writes k + 1 lookahead rows past the end (k = 4 below)
     spec = small_lm_spec(vocab_size=args.vocab, model_dim=args.model_dim,
                          num_heads=4, num_layers=args.layers,
-                         max_seq_len=max(args.seq_len, args.seq_len // 2 + args.sample_len))
+                         max_seq_len=max(args.seq_len,
+                                         args.seq_len // 2 + args.sample_len + 5))
     model = Model.init(spec, seed=0)
     opt = optax.adam(3e-3)
 
@@ -111,8 +115,28 @@ def main() -> None:
               f"({hits}/{args.sample_len} continuation hits)")
     acc = correct / (2 * args.sample_len)
     print(f"continuation accuracy: {acc:.2f}")
-    if last > first or acc < 0.5:
-        print("WARNING: model did not learn the progression structure")
+
+    # the rest of the serving family, same public API: beam search (width
+    # 4, scores are true sequence logprobs) and speculative decoding with
+    # the model as its own draft (every proposal accepted — the committed
+    # tokens are the model's own greedy decode, here nearly deterministic
+    # because the learned progression logits are sharp)
+    from distkeras_tpu.models.beam import make_beam_search_fn
+    from distkeras_tpu.models.speculative import make_speculative_generate_fn
+
+    beam_toks, beam_scores = make_beam_search_fn(spec, args.sample_len,
+                                                 beam_width=4)(
+        trained.params, jnp.asarray(prompt))
+    print(f"beam-4 best scores: {[round(float(s), 2) for s in beam_scores]}")
+    spec_toks = np.asarray(make_speculative_generate_fn(spec, spec,
+                                                        args.sample_len, k=4)(
+        trained.params, trained.params, jnp.asarray(prompt)))
+    spec_agree = float((spec_toks == out).mean())
+    print(f"speculative (self-draft) vs greedy agreement: {spec_agree:.2f}")
+
+    if last > first or acc < 0.5 or spec_agree < 0.9:
+        print("WARNING: model did not learn the progression structure "
+              "or a serving path diverged")
         raise SystemExit(1)
 
 

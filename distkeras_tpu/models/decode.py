@@ -82,6 +82,36 @@ class KVCache(NamedTuple):
     v: jnp.ndarray
 
 
+class QKVCache(NamedTuple):
+    """int8-quantized KV cache: values [L, B, S, H, Dh] int8 with
+    per-(position, head) float32 scales [L, B, S, H, 1].
+
+    Serving memory-bandwidth lever (batched decode reads the whole cache
+    every step — ~86MB/token at bench size, the dominant cost at batch
+    8): storing KV int8 halves that traffic, and XLA fuses the
+    dequantize into the attention dots' operand reads (measured 1.53x on
+    the cache-attention pass, v5e 2026-07-31).  Quantization error is
+    one rounding step per K/V row — NOT bit-exact with the bf16 cache;
+    the ``tests/test_decode.py`` oracle pins that the quantized-cache
+    forward equals a full-precision forward over the SAME
+    rounded-then-dequantized values."""
+
+    k: jnp.ndarray        # int8
+    v: jnp.ndarray        # int8
+    k_scale: jnp.ndarray  # f32 [L, B, S, H, 1]
+    v_scale: jnp.ndarray  # f32
+
+
+def _quantize_rows(x: jnp.ndarray):
+    """[B, L, H, D] -> (int8 values, f32 scales [B, L, H, 1]); symmetric
+    per-(position, head), exact zero rows keep scale 1."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _cfg_dtype(config: dict) -> Any:
     return config.get("compute_dtype", jnp.bfloat16)
 
@@ -111,51 +141,83 @@ def _layer_norm(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
     return (y * p["scale"] + p["bias"]).astype(dtype)
 
 
-def _block(pb: dict, x: jnp.ndarray, k_all: jnp.ndarray, v_all: jnp.ndarray,
-           layer: int, start_pos, dtype) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
     """One transformer block over ``x`` [B, L, E] with KV caching.
 
-    ``k_all``/``v_all`` are the STACKED [layers, B, S, H, Dh] caches; only
-    the L new K/V rows of layer ``layer`` are written (in place when XLA
-    can alias the scan carry — the whole point: rewriting the full cache
-    per decoded token would move ~50MB/token at bench size).  Queries
-    attend over the layer's slab masked to ``key_pos <= start_pos +
-    query_offset``, which also masks dead rows beyond the write head.
+    ``cache`` is the STACKED [layers, B, S, H, Dh] :class:`KVCache` (or
+    :class:`QKVCache`); only the L new K/V rows of layer ``layer`` are
+    written (in place when XLA can alias the scan carry — the whole
+    point: rewriting the full cache per decoded token would move
+    ~50MB/token at bench size).  Queries attend over the layer's slab
+    masked to ``key_pos <= start_pos + query_offset``, which also masks
+    dead rows beyond the write head.
+
+    On a quantized cache the new rows are rounded to int8 on write; the
+    per-(position, head) K scale commutes out of the score dot and the V
+    scale folds into the attention probabilities (both vary only along
+    the key axis), so the int8 slabs feed the einsums directly and XLA
+    fuses the convert into the operand reads — the cache's HBM traffic
+    halves, which is the whole point at decode batch sizes.
     """
-    head_dim = k_all.shape[-1]
+    head_dim = cache.k.shape[-1]
+    quant = isinstance(cache, QKVCache)
 
     y = _layer_norm(pb["LayerNorm_0"], x, dtype)
     qkv = _wmul("ble,eshd->blshd", y, pb["qkv"]["kernel"], dtype)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if quant:
+        k_rows, k_rows_scale = _quantize_rows(k)
+        v_rows, v_rows_scale = _quantize_rows(v)
+    else:
+        k_rows, v_rows = k.astype(cache.k.dtype), v.astype(cache.v.dtype)
     k_all = lax.dynamic_update_slice(
-        k_all, k.astype(k_all.dtype)[None], (layer, 0, start_pos, 0, 0))
+        cache.k, k_rows[None], (layer, 0, start_pos, 0, 0))
     v_all = lax.dynamic_update_slice(
-        v_all, v.astype(v_all.dtype)[None], (layer, 0, start_pos, 0, 0))
+        cache.v, v_rows[None], (layer, 0, start_pos, 0, 0))
     ck, cv = k_all[layer], v_all[layer]
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(dtype) if quant else ck,
                         preferred_element_type=jnp.float32)
     scores = scores * (1.0 / head_dim ** 0.5)
+    if quant:
+        k_scale = lax.dynamic_update_slice(
+            cache.k_scale, k_rows_scale[None], (layer, 0, start_pos, 0, 0))
+        v_scale = lax.dynamic_update_slice(
+            cache.v_scale, v_rows_scale[None], (layer, 0, start_pos, 0, 0))
+        # [L?, B, S, H, 1] -> [B, H, 1, S] broadcast along the key axis
+        scores = scores * k_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, :]
     q_pos = start_pos + lax.broadcasted_iota(jnp.int32, scores.shape, 2)
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     scores = jnp.where(k_pos <= q_pos, scores, float("-inf"))
-    attn = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", attn, cv)
+    attn = jax.nn.softmax(scores, axis=-1)
+    if quant:
+        attn = attn * v_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, :]
+    attn = attn.astype(dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, cv.astype(dtype) if quant else cv)
     o = _wmul("bqhd,hde->bqe", o, pb["proj"]["kernel"], dtype)
     x = x + o
 
     y = _layer_norm(pb["LayerNorm_1"], x, dtype)
     y = jax.nn.gelu(_wmul("ble,ef->blf", y, pb["up"]["kernel"], dtype))
     y = _wmul("blf,fe->ble", y, pb["down"]["kernel"], dtype)
-    return x + y, k_all, v_all
+    new_cache = (QKVCache(k_all, v_all, k_scale, v_scale) if quant
+                 else KVCache(k_all, v_all))
+    return x + y, new_cache
 
 
-def init_cache(config: dict, batch: int, cache_len: int) -> KVCache:
-    """Zero cache sized for ``cache_len`` total positions (prompt + new)."""
+def init_cache(config: dict, batch: int, cache_len: int,
+               quantized: bool = False):
+    """Zero cache sized for ``cache_len`` total positions (prompt + new);
+    ``quantized`` selects the int8 :class:`QKVCache` layout."""
     n_layers = config["num_layers"]
     heads = config["num_heads"]
     head_dim = config["model_dim"] // heads
     shape = (n_layers, batch, cache_len, heads, head_dim)
+    if quantized:
+        sshape = shape[:-1] + (1,)
+        return QKVCache(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                        jnp.ones(sshape, jnp.float32),
+                        jnp.ones(sshape, jnp.float32))
     dtype = _cfg_dtype(config)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
@@ -178,17 +240,15 @@ def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
     pos = start_pos + jnp.arange(tokens.shape[1])
     x = x + params["pos_embed"][pos].astype(dtype)
 
-    k_all, v_all = cache.k, cache.v
     for i in range(n_layers):
-        x, k_all, v_all = _block(params[f"block_{i}"], x, k_all, v_all, i,
-                                 start_pos, dtype)
+        x, cache = _block(params[f"block_{i}"], x, cache, i, start_pos, dtype)
 
     if last_only:
         x = x[:, -1:]
     x = _layer_norm(params["final_norm"], x, dtype)
     logits = jnp.einsum("ble,ve->blv", x.astype(jnp.float32),
                         params["embed"]["embedding"].astype(jnp.float32))
-    return logits, KVCache(k_all, v_all)
+    return logits, cache
 
 
 class FusedStepState(NamedTuple):
@@ -271,7 +331,8 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                      top_p: float = 0.0,
                      eos_id: Optional[int] = None, pad_id: int = 0,
                      cache_len: Optional[int] = None,
-                     step_impl: Optional[str] = None):
+                     step_impl: Optional[str] = None,
+                     quantize_cache: bool = False):
     """Build a jitted ``(params, prompt [B, P], rng) -> tokens [B, max_new]``.
 
     ``cache_len`` defaults to prompt length + ``max_new_tokens`` (it is a
@@ -280,6 +341,13 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     ``temperature == 0``; ``top_k``/``top_p`` (nucleus) filter the sampled
     distribution (see ``_sample``).  Rows that have emitted ``eos_id``
     keep emitting ``pad_id``.
+
+    ``quantize_cache=True`` stores KV int8 with per-(position, head)
+    scales (:class:`QKVCache`): cache HBM traffic halves — the dominant
+    batched-decode cost — at one rounding step of approximation per K/V
+    row (an accuracy/throughput trade, NOT bit-exact; see the QKVCache
+    docstring and the oracle test).  Requires the XLA step
+    (``step_impl`` must not be ``"fused"``).
 
     ``step_impl``: ``None`` auto-selects — the fused Pallas block kernel
     (``ops/decode_step.py``) on TPU when the shapes support it, the XLA
@@ -291,6 +359,9 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     """
     if step_impl not in (None, "fused", "xla"):
         raise ValueError(f"unknown step_impl {step_impl!r}; use None, 'fused' or 'xla'")
+    if quantize_cache and step_impl == "fused":
+        raise ValueError("quantize_cache requires the XLA step: the fused "
+                         "kernel's slabs are bf16 (step_impl='xla' or None)")
     config = validate_decode_spec(spec, "decoding")
     max_seq = config["max_seq_len"]
 
@@ -311,7 +382,8 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the positional table max_seq_len = {max_seq}")
-        cache = init_cache(config, prompt.shape[0], total)
+        cache = init_cache(config, prompt.shape[0], total,
+                           quantized=quantize_cache)
         logits, cache = forward_with_cache(params, config, prompt, 0, cache,
                                            last_only=True)
         rng, sub = jax.random.split(rng)
@@ -358,12 +430,19 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
             rng = jax.random.PRNGKey(0)
         from distkeras_tpu.ops.decode_step import resolve_step_impl
 
-        # auto keys on the MEASURED win region (small models, batch 1 —
-        # see ops.decode_step.fused_step_auto), not just shape support:
-        # the 8-layer/512-dim XLA step is already optimal
-        impl = resolve_step_impl(
-            config, prompt.shape[0],
-            cache_len or (prompt.shape[1] + max_new_tokens), step_impl)
+        if quantize_cache:
+            # the fused kernel's slabs are bf16 — an int8 QKVCache through
+            # it would silently drop the scales.  The explicit-'fused'
+            # combination already raised at build time; auto must resolve
+            # to the XLA step here, not just usually avoid it
+            impl = "xla"
+        else:
+            # auto keys on the MEASURED win region (small models, batch 1
+            # — see ops.decode_step.fused_step_auto), not just shape
+            # support: the 8-layer/512-dim XLA step is already optimal
+            impl = resolve_step_impl(
+                config, prompt.shape[0],
+                cache_len or (prompt.shape[1] + max_new_tokens), step_impl)
         return run(params, prompt, rng, prompt.shape[1], impl)
 
     return generate_fn

@@ -310,3 +310,80 @@ def test_generate_with_top_p_reproducible_and_in_range(model):
     np.testing.assert_array_equal(np.asarray(toks1), np.asarray(toks2))
     a = np.asarray(toks1)
     assert a.shape == (1, 8) and ((a >= 0) & (a < 61)).all()
+
+
+# --- int8-quantized KV cache (QKVCache) ------------------------------------
+
+
+def test_quantized_cache_matches_dequantized_oracle(model, monkeypatch):
+    """The int8-cache forward must equal the SAME math over the
+    rounded-then-dequantized values.  Oracle = the production code path
+    itself, with _quantize_rows faked to store the dequantized f32
+    values at scale 1 (int8 in [-127, 127] converts to bf16/f32
+    exactly, so the two runs differ only in where the scale multiply
+    happens — an exact-to-float-noise identity if the plumbing is
+    right)."""
+    import distkeras_tpu.models.decode as dec
+    from distkeras_tpu.models.decode import QKVCache
+
+    cfg = model.spec.config
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 61, (2, 6)))
+    cache_q = init_cache(cfg, 2, 16, quantized=True)
+    logits_q, cache_q = forward_with_cache(model.params, cfg, toks, 0, cache_q)
+    step_q, _ = forward_with_cache(model.params, cfg,
+                                   jnp.asarray([[7], [9]], jnp.int32),
+                                   jnp.asarray(6, jnp.int32), cache_q)
+
+    real = dec._quantize_rows
+
+    def fake(x):
+        q, s = real(x)
+        return q.astype(jnp.float32) * s, jnp.ones_like(s)
+
+    monkeypatch.setattr(dec, "_quantize_rows", fake)
+    shape = cache_q.k.shape
+    oracle = QKVCache(jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32),
+                      jnp.ones(shape[:-1] + (1,), jnp.float32),
+                      jnp.ones(shape[:-1] + (1,), jnp.float32))
+    logits_o, oracle = forward_with_cache(model.params, cfg, toks, 0, oracle)
+    step_o, _ = forward_with_cache(model.params, cfg,
+                                   jnp.asarray([[7], [9]], jnp.int32),
+                                   jnp.asarray(6, jnp.int32), oracle)
+    np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_o),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(step_q), np.asarray(step_o),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_quantized_cache_generation_runs_and_tracks_plain(model):
+    """End-to-end generate with quantize_cache: valid tokens, and on
+    this tiny f32 model the per-row rounding (<0.8% relative) keeps
+    greedy tokens mostly equal to the full-precision decode."""
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    plain = np.asarray(make_generate_fn(model.spec, 8)(model.params, prompt))
+    quant = np.asarray(make_generate_fn(model.spec, 8, quantize_cache=True)(
+        model.params, prompt))
+    assert quant.shape == plain.shape
+    assert ((quant >= 0) & (quant < 61)).all()
+    assert (quant == plain).mean() >= 0.5, f"{quant} vs {plain}"
+
+
+def test_quantized_cache_rejects_fused_step(fused_model):
+    with pytest.raises(ValueError, match="quantize_cache"):
+        make_generate_fn(fused_model.spec, 4, quantize_cache=True,
+                         step_impl="fused")
+
+
+def test_quantized_cache_forces_xla_step_on_tpu_auto(fused_model, monkeypatch):
+    """With quantize_cache the auto step selection must resolve to the
+    XLA step even where fused_step_auto would fire (TPU, batch 1, small
+    model) — the fused kernel's bf16 slabs would silently drop the int8
+    scales.  Faking a TPU backend on CPU makes the bug observable: the
+    buggy path tries to Mosaic-compile the fused kernel and fails, the
+    fixed path decodes through XLA."""
+    import distkeras_tpu.ops.decode_step as ds
+
+    monkeypatch.setattr(ds.jax, "default_backend", lambda: "tpu")
+    toks = make_generate_fn(fused_model.spec, 5, quantize_cache=True)(
+        fused_model.params, jnp.asarray([[8, 2]], jnp.int32))
+    assert np.asarray(toks).shape == (1, 5)
