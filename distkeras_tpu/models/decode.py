@@ -359,25 +359,42 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
     """
     if step_impl not in (None, "fused", "xla"):
         raise ValueError(f"unknown step_impl {step_impl!r}; use None, 'fused' or 'xla'")
+    if not 0.0 <= top_p <= 1.0:  # also rejects NaN
+        raise ValueError(f"top_p must be in [0, 1], got {top_p} (a negative "
+                         "value would mask every token — including the argmax "
+                         "— and categorical over an all--inf row silently "
+                         "emits token 0)")
+    if not temperature >= 0.0:  # also rejects NaN
+        raise ValueError(f"temperature must be >= 0, got {temperature} "
+                         "(a negative value would silently select greedy)")
     if quantize_cache and step_impl == "fused":
         raise ValueError("quantize_cache requires the XLA step: the fused "
                          "kernel's slabs are bf16 (step_impl='xla' or None)")
     config = validate_decode_spec(spec, "decoding")
+    if not 0 <= top_k <= config["vocab_size"]:
+        raise ValueError(f"top_k must be in [0, vocab_size="
+                         f"{config['vocab_size']}], got {top_k} "
+                         "(out-of-range values fail at trace time inside "
+                         "lax.top_k, not here where the mistake is visible)")
     max_seq = config["max_seq_len"]
 
     @functools.partial(jax.jit, static_argnames=("prompt_len", "impl"))
     def run(params, prompt, rng, prompt_len, impl):
         params = dequant_embed(params)
         total = cache_len or (prompt_len + max_new_tokens)
-        if impl == "fused":
-            from distkeras_tpu.ops.decode_step import round_cache_len
-
-            total = round_cache_len(total)  # K-slab lane tiling
+        # validate the user-supplied capacity BEFORE the fused path rounds it
+        # up to a lane multiple, so both impls accept/reject identically (an
+        # undersized cache_len must not pass on one step_impl and raise on
+        # the other depending on auto-selection)
         if prompt_len + max_new_tokens > total:
             raise ValueError(
                 f"cache_len = {total} cannot hold prompt ({prompt_len}) + "
                 f"max_new_tokens ({max_new_tokens}); out-of-range cache "
                 "writes would silently clamp and corrupt generation")
+        if impl == "fused":
+            from distkeras_tpu.ops.decode_step import round_cache_len
+
+            total = round_cache_len(total)  # K-slab lane tiling
         if prompt_len + max_new_tokens > max_seq:
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "

@@ -132,17 +132,26 @@ _VMEM_BUDGET = 72 * 1024 * 1024
 
 def _kernel_vmem_bytes(config: dict, batch: int, cache_len: int) -> int:
     """Rough VMEM working set: both KV slabs + double-buffered weight
-    blocks + the [B*H, B*S] f32 score block and its exp/mask copies."""
+    blocks + the [B*H, B*S] f32 score block and its exp/mask copies +
+    the per-layer activation slabs (sublane-padded qkv output [B8, 3E]
+    and MLP up-projection [B8, F] — near the budget these are what
+    pushes a shape past the grant, so omitting them would let
+    ``fused_step_supported`` pass a shape that dies at Mosaic compile
+    time, the exact failure the gate exists to prevent)."""
     e = config["model_dim"]
     h = config["num_heads"]
     f = config.get("mlp_ratio", 4) * e
     import numpy as np
 
     dsize = np.dtype(config.get("compute_dtype", jnp.bfloat16)).itemsize
+    b8 = -(-batch // 8) * 8  # rows are sublane-padded to 8
     slabs = 2 * batch * cache_len * e * dsize
     weight_block = (e * 3 * e + e * e + e * f + f * e) * dsize * 2
     scores = 3 * (batch * h) * (batch * cache_len) * 4
-    return slabs + weight_block + scores
+    # the matmuls producing these run at preferred_element_type=f32, so the
+    # live buffer is f32 plus its compute-dtype downcast copy
+    acts = (b8 * 3 * e + b8 * f) * (4 + dsize)
+    return slabs + weight_block + scores + acts
 
 
 def fused_step_supported(config: dict, batch: int, cache_len: int) -> bool:

@@ -302,6 +302,33 @@ def test_top_p_restricts_support_and_keeps_argmax():
     assert len(seen) == 2, seen
 
 
+def test_top_p_out_of_range_rejected(model):
+    """A negative top_p would pass the `top_p and top_p < 1.0` gate, mask
+    every token (including the argmax) to -inf, and categorical over an
+    all--inf row silently emits token 0 — so the builder must reject it
+    loudly, like the speculative path's temperature guard."""
+    for bad in (-0.1, 1.5, float("nan")):
+        with pytest.raises(ValueError, match="top_p"):
+            make_generate_fn(model.spec, 4, temperature=1.0, top_p=bad)
+    with pytest.raises(ValueError, match="temperature"):
+        make_generate_fn(model.spec, 4, temperature=-1.0)
+    for bad_k in (-1, 10_000):
+        with pytest.raises(ValueError, match="top_k"):
+            make_generate_fn(model.spec, 4, temperature=1.0, top_k=bad_k)
+
+
+def test_undersized_cache_len_rejected_on_both_impls(fused_model):
+    """cache_len=100 for prompt 90 + 20 new tokens must raise on BOTH
+    step impls: the fused path's lane round-up (100 -> 128) must not
+    rescue a capacity the user explicitly undersized (the same call
+    erroring or not depending on auto impl selection)."""
+    prompt = jnp.zeros((1, 90), jnp.int32)
+    for impl in ("xla", "fused"):
+        with pytest.raises(ValueError, match="cannot hold"):
+            make_generate_fn(fused_model.spec, 20, cache_len=100,
+                             step_impl=impl)(fused_model.params, prompt)
+
+
 def test_generate_with_top_p_reproducible_and_in_range(model):
     toks1 = generate(model, jnp.asarray([[3, 7]], jnp.int32), 8,
                      temperature=0.8, top_p=0.9, seed=5)
