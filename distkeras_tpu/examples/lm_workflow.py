@@ -71,8 +71,16 @@ def main() -> None:
     # position budget: training needs seq_len; generation needs the
     # prompt half + sample_len, and the speculative demo additionally
     # writes k + 1 lookahead rows past the end (k = 4 below)
+    # head_dim as close to the v5e-recommended 128 as divisibility allows
+    # (BASELINE.md head-dim study): smallest head count that divides
+    # model_dim with head_dim <= 128 — at the default 128-dim demo model
+    # that is a single head
+    num_heads = next(h for h in range(max(1, -(-args.model_dim // 128)),
+                                      args.model_dim + 1)
+                     if args.model_dim % h == 0 and args.model_dim // h <= 128)
     spec = small_lm_spec(vocab_size=args.vocab, model_dim=args.model_dim,
-                         num_heads=4, num_layers=args.layers,
+                         num_heads=num_heads,
+                         num_layers=args.layers,
                          max_seq_len=max(args.seq_len,
                                          args.seq_len // 2 + args.sample_len + 5))
     model = Model.init(spec, seed=0)

@@ -116,7 +116,12 @@ class TransformerLM(nn.Module):
 
     vocab_size: int = 32000
     model_dim: int = 512
-    num_heads: int = 8
+    num_heads: int = 4   # head_dim 128 = model_dim/num_heads: the v5e-
+                         # recommended config (BASELINE.md head-dim study:
+                         # at IDENTICAL FLOPs, head_dim 128 contracts the
+                         # attention matmuls over the MXU's full 128-wide
+                         # systolic dim and halves per-score VPU overhead —
+                         # 0.577 vs 0.389 MFU at 2k tokens vs head_dim 64)
     num_layers: int = 6
     max_seq_len: int = 2048
     mlp_ratio: int = 4
@@ -204,13 +209,17 @@ class TransformerLM(nn.Module):
         return self.head(self._trunk(tokens, pos_offset))
 
 
-def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 4,
+def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int = 2,
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None, remat: bool = False,
                   moe_experts: int = 0, moe_capacity: int = 0,
                   attn_impl: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
+    # num_heads defaults keep head_dim = model_dim/num_heads at 128, the
+    # v5e-recommended config (see TransformerLM.num_heads); pass num_heads
+    # explicitly when a different head_dim is the point (A/B experiments,
+    # tp_size divisibility)
     return ModelSpec(
         name="transformer_lm",
         config={

@@ -347,12 +347,31 @@ def _forward(q, k, v, cfg: _Config):
 #     (the 32k leg runs them via q-chunking), OOM at unchunked Lq=32k;
 #   (512, 512) blocks fit through Lq=32k (dq scratch 8.4M);
 #   above that, fall back to the two-kernel backward with wide blocks.
+# SINGLE-BLOCK tier (round-5, D=128 re-sweep): when the k block spans the
+# WHOLE sequence (reachable from auto-select when Lq, Lk <= 2048 — the
+# square Lq = Lk case is the measured one; cross-length shapes like
+# Lq 2048 / Lk 1024 take the same single-k-block structure) the fused
+# backward in one grid step beats (1024, 1024) despite skipping no causal
+# blocks — the
+# same fewer-passes-beats-fewer-FLOPs tradeoff the forward measured: 1.43
+# vs 1.57 ms/step on the 2k hd128 attention leg.  Its [bq, bk] f32
+# score/dp + bf16 p tiles (~10 B/element) outgrow the standard 24M grant,
+# so ``_bwd_compiler_params`` sizes the grant per call (48M measured flat
+# vs 56/64M).  At 8k the same wide blocks LOSE (5.20 vs 4.92: q-chunks
+# re-stream k/v and forgo the 44% causal-skip), hence the lk == bk_kv
+# containment rather than a general wide tier.
 _FUSED_WIDE_CAP = 5 * 1024 * 1024       # dq / lk-stream cap for 1024-wide blocks
 _FUSED_DQ_SCRATCH_CAP = 12 * 1024 * 1024  # dq scratch cap for (<=512, <=512)
+_BWD_WS_BYTES_PER_ELEM = 10             # f32 s + f32 dp + bf16 p per score
+_BWD_WIDE_WS_CAP = 44 * 1024 * 1024     # blocks through (2048, 2048)
 
 
 def _fused_bwd_ok(lq: int, d: int, bq_kv: int, bk_kv: int, lk: int) -> bool:
     dq_bytes = lq * d * 4
+    if bk_kv == lk and 1024 < max(bq_kv, bk_kv) <= 2048:
+        # single-k-block wide tier: one (or few) grid passes, sized grant
+        return (dq_bytes <= _FUSED_DQ_SCRATCH_CAP
+                and bq_kv * bk_kv * _BWD_WS_BYTES_PER_ELEM <= _BWD_WIDE_WS_CAP)
     if bk_kv > 1024:
         return False
     if bq_kv > 1024:
@@ -362,6 +381,20 @@ def _fused_bwd_ok(lq: int, d: int, bq_kv: int, bk_kv: int, lk: int) -> bool:
     if bk_kv <= 512:
         return dq_bytes <= _FUSED_DQ_SCRATCH_CAP
     return dq_bytes <= _FUSED_WIDE_CAP
+
+
+def _bwd_compiler_params(bq_kv: int, bk_kv: int) -> pltpu.CompilerParams:
+    """Scoped-vmem grant for a backward call, sized to its score-tile
+    working set: the standard minimum-that-fits 24M grant through
+    (1024, 1024) blocks; the wide single-block tier measured fastest at
+    48M (v5e 2026-07-31: 48M == 56M == 64M within noise, all faster than
+    any 24M-compatible blocking).  >= so the boundary pair (2048, 1024)
+    — reachable cross-length, e.g. Lq 2048 vs Lk 1024 — gets the sized
+    grant its exactly-20M score tiles need rather than the 24M grant
+    that only fits the 10M working set of (1024, 1024)."""
+    if bq_kv * bk_kv * _BWD_WS_BYTES_PER_ELEM >= 20 * 1024 * 1024:
+        return pltpu.CompilerParams(vmem_limit_bytes=48 * 1024 * 1024)
+    return _COMPILER_PARAMS
 
 
 def _fused_backward_call(q, k, v, do, lse, delta, cfg: _Config, scale: float):
@@ -395,7 +428,7 @@ def _fused_backward_call(q, k, v, do, lse, delta, cfg: _Config, scale: float):
             pltpu.VMEM((lq, d), jnp.float32),
         ],
         interpret=cfg.interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_bwd_compiler_params(bq_kv, bk_kv),
     )(q, k, v, do, lse, delta)
 
 
@@ -498,7 +531,7 @@ def _backward(q, k, v, o, lse, do, cfg: _Config, dlse=None):
             pltpu.VMEM((bk_kv, d), jnp.float32),
         ],
         interpret=cfg.interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_bwd_compiler_params(bq_kv, bk_kv),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -635,10 +668,16 @@ def _make_config(q, k, causal, q_offset, k_offset, block_q, block_k,
     if block_q_bwd is None and block_k_bwd is None:
         # backward defaults aim for the FUSED single-pass backward kernel
         # (one s/p recompute instead of two — measured 25-30% off the whole
-        # fwd+bwd step on v5e): its dq scratch prefers (512, 1024) blocks,
-        # degrading to (512, 512) and then to the two-kernel path with
-        # forward-inherited blocks as Lq * D grows (see _fused_bwd_ok)
-        if _fused_q_chunks(lq, d, min(block_q, 1024), min(block_k, 1024), lk):
+        # fwd+bwd step on v5e): first the single-block wide tier (only
+        # reachable when the forward already runs full-length blocks, i.e.
+        # Lq = Lk <= 2048 — see the _fused_bwd_ok tier note), then
+        # (1024, 1024), degrading to (512, 1024), (512, 512) and finally
+        # the two-kernel path with forward-inherited blocks as Lq * D
+        # grows (see _fused_bwd_ok)
+        if _fused_q_chunks(lq, d, min(block_q, 2048), min(block_k, 2048), lk):
+            dq_q = dkv_q = min(block_q, 2048)
+            dq_k = dkv_k = min(block_k, 2048)
+        elif _fused_q_chunks(lq, d, min(block_q, 1024), min(block_k, 1024), lk):
             dq_q = dkv_q = min(block_q, 1024)
             dq_k = dkv_k = min(block_k, 1024)
         elif _fused_q_chunks(lq, d, min(block_q, 512), min(block_k, 1024), lk):

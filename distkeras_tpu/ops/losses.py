@@ -23,13 +23,32 @@ from jax import lax
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
-def _pick_chunks(rows: int, target_rows: int) -> int:
+# logits-size ceiling for the UNchunked CE path: below this the [rows, V]
+# f32 logits (plus cotangent) fit HBM comfortably and the dense form beats
+# the chunked lax.map — measured on the 2k hd128 train leg (v5e device
+# time, 2026-07-31): dense 31.8ms/step vs 32.7 chunked-2048 (the map's
+# sequential DUS accumulation plus the checkpoint's extra forward matmul
+# cost MORE than the extra HBM traffic of materializing 537MB of logits).
+# Above the ceiling (e.g. the 32k leg's 1GB logits) chunking still wins —
+# it exists for memory, and there it also measures faster.
+_DENSE_CE_BYTES = 640 * 1024 * 1024
+_DEFAULT_CHUNK_ROWS = 2048  # chunk size target when the policy must chunk
+
+
+def _pick_chunks(rows: int, vocab: int, target_rows: Optional[int]) -> int:
     """Chunk count with the largest chunk size that divides ``rows`` and
-    stays <= ``target_rows``.  Awkward factorizations (e.g. prime ``rows``,
+    stays <= ``target_rows``.  One dense chunk when the full [rows, V] f32
+    logits stay under ``_DENSE_CE_BYTES`` (measured faster — see above;
+    the ceiling applies only on the DEFAULT policy ``target_rows=None`` —
+    an explicit ``chunk_rows`` is a caller's memory bound and is honored
+    strictly) or when ``rows`` factorizes awkwardly (e.g. prime ``rows``,
     where the only fitting divisor would mean near-per-row chunks and a
-    long sequential ``lax.map``) fall back to a single dense chunk —
-    materializing the logits once beats serializing thousands of tiny
-    matmuls."""
+    long sequential ``lax.map``) — materializing the logits once beats
+    serializing thousands of tiny matmuls."""
+    if target_rows is None:
+        if rows * vocab * 4 <= _DENSE_CE_BYTES:
+            return 1
+        target_rows = _DEFAULT_CHUNK_ROWS
     if rows <= target_rows:
         return 1
     for n in range(2, rows + 1):
@@ -41,24 +60,29 @@ def _pick_chunks(rows: int, target_rows: int) -> int:
 
 
 def unembed_cross_entropy(hidden: jnp.ndarray, table: jnp.ndarray,
-                          targets: jnp.ndarray, chunk_rows: int = 2048,
+                          targets: jnp.ndarray, chunk_rows: Optional[int] = None,
                           compute_dtype: Optional[jnp.dtype] = jnp.bfloat16) -> jnp.ndarray:
-    """Fused unembed + softmax CE that never materializes full logits.
+    """Fused unembed + softmax CE whose logits stay bounded: chunked when
+    they would be large, dense when materializing them once is faster.
 
     ``hidden`` [B, L, E] (final-norm output), ``table`` [V, E] (the tied
     embedding matrix), ``targets`` [B, L] int.  Returns per-position CE
-    [B, L] in float32.
+    [B, L] in float32.  ``chunk_rows=None`` (default) picks the measured
+    policy below; an EXPLICIT ``chunk_rows`` is treated as a hard memory
+    bound — the dense fast path is never taken over it.
 
     Two wins over ``head() -> optax CE`` on TPU:
 
     - the unembed matmul runs in ``compute_dtype`` (default bfloat16 — full
       MXU rate) with float32 accumulation via ``preferred_element_type``,
       instead of the float32 x float32 matmul ``embed.attend`` issues;
-    - the [B*L, V] float32 logits tensor is computed ``chunk_rows`` rows at
-      a time inside a ``lax.map`` whose body is ``jax.checkpoint``'d, so
-      the backward recomputes each chunk instead of keeping ~0.5 GB of
-      logits (+ another in the cotangent) live across the whole backward.
-      Peak logit memory drops from O(B*L*V) to O(chunk_rows * V).
+    - when the [B*L, V] float32 logits would exceed ``_DENSE_CE_BYTES``
+      they are computed ``chunk_rows`` rows at a time inside a ``lax.map``
+      whose body is ``jax.checkpoint``'d, so the backward recomputes each
+      chunk instead of keeping ~1 GB of logits (+ another in the
+      cotangent) live across the whole backward.  Peak logit memory drops
+      from O(B*L*V) to O(chunk_rows * V).  Below the ceiling the dense
+      single-matmul form runs (measured faster; see ``_pick_chunks``).
 
     ``compute_dtype=None`` keeps the inputs' dtype (exact-parity testing).
     """
@@ -77,7 +101,7 @@ def unembed_cross_entropy(hidden: jnp.ndarray, table: jnp.ndarray,
         tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
         return lse - tgt
 
-    n_chunks = _pick_chunks(rows, chunk_rows)
+    n_chunks = _pick_chunks(rows, table.shape[0], chunk_rows)
     if n_chunks == 1:
         ce = chunk_ce(h2, t2)
     else:
@@ -89,7 +113,7 @@ def unembed_cross_entropy(hidden: jnp.ndarray, table: jnp.ndarray,
 
 
 def lm_token_cross_entropy(module, params, tokens: jnp.ndarray, targets: jnp.ndarray,
-                           pos_offset=0, chunk_rows: int = 2048,
+                           pos_offset=0, chunk_rows: Optional[int] = None,
                            compute_dtype: Optional[jnp.dtype] = jnp.bfloat16) -> jnp.ndarray:
     """Per-position next-token CE [B, L] for a tied-embedding LM.
 

@@ -180,6 +180,33 @@ def test_split_backward_fallback_matches_fused():
                                    err_msg=f"fused/split grad mismatch for {name}")
 
 
+def test_single_block_bwd_tier_selection():
+    """The round-5 wide tier: auto-select takes the single-block fused
+    backward exactly when the forward runs full-length blocks (Lq = Lk
+    <= 2048) past 1024, keeps the (1024, 1024) rung at 8k+, and sizes
+    the scoped-vmem grant to the score-tile working set."""
+    import distkeras_tpu.ops.flash_attention as fa
+
+    def cfg_for(l):
+        q = jnp.zeros((1, l, 4, 128), jnp.bfloat16)
+        return fa._make_config(q, q, True, 0, 0, None, None, None, None, True)
+
+    c2k = cfg_for(2048)
+    assert (c2k.block_q_bwd, c2k.block_k_bwd) == (2048, 2048)
+    c8k = cfg_for(8192)
+    assert (c8k.block_q_bwd, c8k.block_k_bwd) == (1024, 1024)
+    c1k = cfg_for(1024)  # already single-block under the pre-existing rungs
+    assert (c1k.block_q_bwd, c1k.block_k_bwd) == (1024, 1024)
+    # the wide tier is gated on the k block spanning the WHOLE sequence:
+    # 2048-wide k blocks against a longer sequence are rejected (measured
+    # slower at 8k — q-chunks re-stream k/v and give up the causal skip)
+    assert not fa._fused_bwd_ok(2048, 128, 2048, 2048, 8192)
+    assert fa._fused_bwd_ok(2048, 128, 2048, 2048, 2048)
+    # grant sizing: standard 24M through (1024, 1024), 48M for the wide tier
+    assert fa._bwd_compiler_params(1024, 1024).vmem_limit_bytes == fa._VMEM_LIMIT
+    assert fa._bwd_compiler_params(2048, 2048).vmem_limit_bytes == 48 * 1024 * 1024
+
+
 def test_bwd_blocks_inherit_explicit_fwd_blocks():
     """Explicit block_q/block_k govern the backward too (multi-block bwd
     scratch accumulation is exercised), and a full-length block on a

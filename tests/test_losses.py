@@ -17,14 +17,25 @@ def _data(b=2, l=16, e=32, v=64, seed=0):
 
 
 def test_pick_chunks():
-    assert _pick_chunks(32, 2048) == 1       # fits in one chunk
-    assert _pick_chunks(4096, 2048) == 2
-    assert _pick_chunks(4096, 1000) == 8     # next divisor under target
+    big_v = 65536  # past the dense ceiling at these row counts
+    assert _pick_chunks(32, big_v, 2048) == 1       # fits in one chunk
+    assert _pick_chunks(4096, big_v, 2048) == 2
+    assert _pick_chunks(4096, big_v, 1000) == 8     # next divisor under target
     # awkward factorizations (prime rows: only fitting divisor means
     # near-per-row chunks) fall back to one dense chunk, never a long
     # sequential map of tiny matmuls
-    assert _pick_chunks(6002, 2048) == 1     # 2 * 3001
-    assert _pick_chunks(7919, 2048) == 1     # prime
+    assert _pick_chunks(6002, big_v, 2048) == 1     # 2 * 3001
+    assert _pick_chunks(7919, big_v, 2048) == 1     # prime
+    # DEFAULT policy (target None): below the dense-logits ceiling the
+    # single dense chunk wins outright (measured: the chunked map's DUS +
+    # checkpoint recompute cost more than materializing ~0.5GB of logits
+    # once) — the 2k/8k LM legs
+    assert _pick_chunks(16384, 8192, None) == 1
+    # the 32k leg's 1GB logits stay chunked: memory is why chunking exists
+    assert _pick_chunks(32768, 8192, None) == 16
+    # an EXPLICIT chunk_rows is a caller's memory bound: honored strictly,
+    # never overridden by the dense fast path
+    assert _pick_chunks(16384, 8192, 2048) == 8
 
 
 def test_matches_optax_dense_f32():
