@@ -724,6 +724,92 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
     }
 
 
+def _bench_moe(*, batch: int = 4, seq_len: int = 512, model_dim: int = 512,
+               num_heads: int = 4, num_layers: int = 8, vocab: int = 8192,
+               experts: int = 8, reps: int = 3):
+    """Switch-MoE TransformerLM train step (make_moe_lm_train_step) on the
+    real chip: tokens/sec + expert-FLOP-accounted MFU for top-1 (Switch)
+    and top-2 (GShard-style) routing, with the router stats surfaced.
+
+    MFU accounting: the model-required matmul FLOPs — dense projections,
+    causal attention, unembed, router, and the EXECUTED expert compute
+    (E * capacity slots through up/down, i.e. the capacity-padded slabs
+    the MXU actually runs, x3 for fwd+bwd) — over device time.  The
+    one-hot dispatch/combine einsums are ROUTING OVERHEAD, excluded from
+    MFU but reported as ``dispatch_flops_pct`` so the cost of the
+    static-shape dispatch design is a number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.parallel.mesh import create_nd_mesh
+    from distkeras_tpu.parallel.moe import (make_moe_lm_train_step,
+                                            moe_data_sharding,
+                                            moe_state_shardings)
+    from distkeras_tpu.parallel.lm import shift_targets
+
+    e, f = model_dim, 4 * model_dim
+    t = batch * seq_len
+    cap = -(-2 * t // experts)  # the TransformerBlock default (factor-2)
+    mesh = create_nd_mesh((1, 1), ("dp", "ep"))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, size=(batch, seq_len)).astype(np.int32)
+    tgts = shift_targets(toks)
+    peak = _peak_flops(jax.devices()[0].device_kind)
+
+    # per-step matmul FLOPs (fwd x3 for fwd+bwd), PaLM-style: per layer
+    # the experts' executed slabs (E*cap slots through up+down), qkv+proj
+    # (4e^2 per token), causal attention (2*B*L^2*E fwd), the router; plus
+    # the tied unembed once
+    expert_fl = 3 * 4 * experts * cap * e * f
+    attn_proj_fl = 3 * (2 * t * 4 * e * e + 2 * batch * seq_len * seq_len * e)
+    router_fl = 3 * 2 * t * e * experts
+    unembed_fl = 3 * 2 * t * e * vocab
+    model_fl = num_layers * (expert_fl + attn_proj_fl + router_fl) + unembed_fl
+    dispatch_fl = num_layers * 3 * (4 * t * experts * cap * e)
+
+    out = {"batch": batch, "seq_len": seq_len, "experts": experts,
+           "capacity": cap}
+    for top_k in (1, 2):
+        spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
+                             num_heads=num_heads, num_layers=num_layers,
+                             max_seq_len=seq_len, moe_experts=experts,
+                             moe_top_k=top_k)
+        model = Model.init(spec, seed=0)
+        opt = optax.sgd(0.01)
+        step = make_moe_lm_train_step(spec, opt, mesh)
+        psh, osh = moe_state_shardings(mesh, opt, model.params)
+        params = jax.device_put(jax.tree.map(jnp.asarray, model.params), psh)
+        opt_state = jax.device_put(opt.init(params), osh)
+        dsh = moe_data_sharding(mesh)
+        tok_d, tgt_d = jax.device_put(toks, dsh), jax.device_put(tgts, dsh)
+        state = {"p": params, "o": opt_state, "stats": None}
+
+        def run_once(state=state, step=step, tok_d=tok_d, tgt_d=tgt_d):
+            # donated params/opt_state: thread the NEW state through so
+            # every call uses live buffers
+            state["p"], state["o"], loss, state["stats"] = step(
+                state["p"], state["o"], tok_d, tgt_d)
+            return loss
+
+        ms, spread, source = _device_time_ms(run_once, reps=reps)
+        sec = ms / 1e3
+        out[f"top{top_k}"] = {
+            "tokens_per_sec": round(t / sec, 1),
+            "ms_per_step": round(ms, 2),
+            "mfu": round(model_fl / sec / peak, 4) if peak else None,
+            "dispatch_flops_pct": round(100 * dispatch_fl / (model_fl + dispatch_fl), 1),
+            "dropped_fraction": round(float(state["stats"]["dropped_fraction"]), 4),
+            "max_expert_load": round(float(state["stats"]["max_expert_load"]), 3),
+            "wall_spread": spread,
+            "timing": source,
+        }
+    return out
+
+
 def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
                  windows_per_epoch: int = 8, epochs: int = 3):
     """Genuinely-async trainer family (runtime/async_trainer.py) on the
@@ -850,6 +936,16 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
         r = _leg_ratio(base.get("flash_ms"), leg.get("flash_ms"))
         if r is not None:
             leg["vs_baseline"] = r
+    moe = out.get("moe", {})
+    for mode in ("top1", "top2"):
+        sub = moe.get(mode)
+        if isinstance(sub, dict) and sub.get("timing") == "device":
+            key = (f"moe:{mode}:b{moe.get('batch')}s{moe.get('seq_len')}"
+                   f"e{moe.get('experts')}:device")
+            base = baseline.get("legs", {}).get(key, {})
+            r = _leg_ratio(sub.get("tokens_per_sec"), base.get("tokens_per_sec"))
+            if r is not None:
+                sub["vs_baseline"] = r
     # async legs are wall-timed by nature (a host-driven loop IS the thing
     # measured), and wall on the relay swings ±30% — so their tripwire keys
     # on per-window DEVICE time, which is tenancy-stable; ms ratio inverted
@@ -976,6 +1072,11 @@ def main() -> None:
                 out["decode"] = _bench_decode()
             except Exception as e:
                 out["decode"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["moe"] = _bench_moe()
+            except Exception as e:
+                out["moe"] = {"error": f"{type(e).__name__}: {e}"}
             gc.collect()
             try:
                 out["async"] = _bench_async()

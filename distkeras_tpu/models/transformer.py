@@ -52,7 +52,8 @@ class TransformerBlock(nn.Module):
                                      # (ring attention governs that path)
     moe_experts: int = 0       # > 0 replaces the dense FFN with a Switch
     moe_capacity: int = 0      # MoE layer (see parallel/moe.py); capacity
-    ep_axis: Optional[str] = None   # is per-expert slots per shard
+    moe_top_k: int = 1         # is per-expert slots per shard; top_k 1=
+    ep_axis: Optional[str] = None   # Switch, 2 = GShard-style gating
     ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -92,6 +93,7 @@ class TransformerBlock(nn.Module):
                 hidden_dim=self.mlp_ratio * self.model_dim,
                 capacity=cap,
                 ep_axis=self.ep_axis, ep_size=self.ep_size,
+                router_top_k=self.moe_top_k,
                 compute_dtype=self.compute_dtype, name="moe")(y.reshape(b * l, e))
             self.sow("aux_loss", "load_balance", aux)
             return x + moe_out.reshape(b, l, e)
@@ -136,6 +138,7 @@ class TransformerLM(nn.Module):
     moe_capacity: int = 0      # (0 = default to 2x the balanced share per
                                # expert; imbalanced routing beyond that
                                # still drops tokens to the residual path)
+    moe_top_k: int = 1         # 1 = Switch routing, 2 = GShard-style top-2
     ep_axis: Optional[str] = None
     ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16
@@ -161,6 +164,7 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl,
                 moe_experts=self.moe_experts,
                 moe_capacity=self.moe_capacity,
+                moe_top_k=self.moe_top_k,
                 ep_axis=self.ep_axis,
                 ep_size=self.ep_size,
                 compute_dtype=self.compute_dtype,
@@ -213,6 +217,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None, remat: bool = False,
                   moe_experts: int = 0, moe_capacity: int = 0,
+                  moe_top_k: int = 1,
                   attn_impl: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
@@ -233,6 +238,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "remat": remat,
             "moe_experts": moe_experts,
             "moe_capacity": moe_capacity,
+            "moe_top_k": moe_top_k,
             # None = auto-select per ops.attention.attention (flash on TPU
             # at L >= 2048, device-time validated across head_dim 64/128);
             # "flash"/"dense" pin the kernel for A/B measurement

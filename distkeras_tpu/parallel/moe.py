@@ -5,11 +5,13 @@ completes the framework's parallelism suite (dp/sp/tp/pp/ep).  The design
 is the standard TPU MoE shape (Switch Transformer / Mesh-TF lineage),
 built for the MXU and ICI:
 
-- **Top-1 routing with static capacity.**  Each token picks its best
-  expert; each expert accepts at most ``capacity`` tokens per shard (the
-  rest fall through on the residual path).  Everything is dense one-hot
-  einsums over static shapes — no gather/scatter, no dynamic shapes, so
-  XLA tiles all of it onto the MXU.
+- **Top-k routing with static capacity** (``router_top_k``: 1 = Switch,
+  2 = GShard-style gating with renormalized pair weights and rank
+  priority — every token's first choice seats before any second
+  choice).  Each expert accepts at most ``capacity`` tokens per shard
+  (the rest fall through on the residual path).  Everything is dense
+  one-hot einsums over static shapes — no gather/scatter, no dynamic
+  shapes, so XLA tiles all of it onto the MXU.
 - **Experts live sharded over ``ep``.**  Dispatch is two
   ``lax.all_to_all``s over the mesh axis: token slots [E, C, D] travel to
   the shard owning their expert, come back as expert outputs — the
@@ -39,7 +41,7 @@ import flax.linen as nn
 
 
 class MoEMLP(nn.Module):
-    """Router + E experts (each a 2-layer gelu MLP), top-1 dispatch.
+    """Router + E experts (each a 2-layer gelu MLP), top-k dispatch.
 
     Call with tokens [T, D] -> (out [T, D], aux_loss scalar).  ``ep_axis``
     set (and bound by an enclosing shard_map) runs expert-parallel: this
@@ -63,6 +65,7 @@ class MoEMLP(nn.Module):
     capacity: int  # per-expert slots PER SHARD
     ep_axis: Optional[str] = None
     ep_size: int = 1
+    router_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 gating
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -71,6 +74,11 @@ class MoEMLP(nn.Module):
         if d != self.model_dim:
             raise ValueError(f"tokens have dim {d}, module declares model_dim={self.model_dim}")
         e, c, f = self.num_experts, self.capacity, self.hidden_dim
+        k_r = self.router_top_k
+        if k_r not in (1, 2):
+            raise ValueError(f"router_top_k must be 1 or 2, got {k_r}")
+        if k_r > e:
+            raise ValueError(f"router_top_k {k_r} exceeds num_experts {e}")
         if e % self.ep_size:
             raise ValueError(f"num_experts {e} not divisible by ep_size {self.ep_size}")
         e_local = e // self.ep_size
@@ -79,26 +87,48 @@ class MoEMLP(nn.Module):
         w_down_l = self.param("w_down", nn.initializers.lecun_normal(), (e_local, f, d))
 
         xc = x.astype(self.compute_dtype)
-        # -- routing (float32 for a stable softmax/argmax) ---------------------
+        # -- routing (float32 for a stable softmax/top-k) ----------------------
         scores = jax.nn.softmax((x.astype(jnp.float32) @ router.astype(jnp.float32)),
                                 axis=-1)  # [T, E]
-        best = jnp.argmax(scores, axis=-1)                     # [T]
-        best_prob = jnp.max(scores, axis=-1)                   # [T]
-        onehot = jax.nn.one_hot(best, e, dtype=jnp.float32)    # [T, E]
-        # position of each token in its chosen expert's queue; beyond-capacity
-        # tokens are dropped (residual path, standard Switch behavior)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # [T, E]; -1 off-choice
-        pos_in_queue = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
-        keep = pos_in_queue < c
-        slot = jax.nn.one_hot(jnp.where(keep, pos_in_queue, -1), c,
-                              dtype=jnp.float32)               # [T, C]; dropped -> all-zero
-        dispatch = onehot[:, :, None] * slot[:, None, :]       # [T, E, C]
-        combine = dispatch * best_prob[:, None, None]          # [T, E, C]
+        # gate weights: Switch (k=1) uses the raw top prob; top-2 uses the
+        # GShard form — the pair's probs renormalized to sum to 1
+        gate_probs, choice = lax.top_k(scores, k_r)            # [T, k]
+        if k_r > 1:
+            gate_probs = gate_probs / jnp.sum(gate_probs, axis=-1, keepdims=True)
+        onehots = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [T, k, E]
+        # queue positions with RANK priority (GShard): every token's first
+        # choice is seated before any token's second choice, so adding a
+        # second choice never evicts someone's first.  The rank-major
+        # [k*T, E] cumsum implements exactly that order; beyond-capacity
+        # assignments drop (residual path, standard Switch behavior)
+        rank_major = jnp.swapaxes(onehots, 0, 1).reshape(k_r * t, e)  # [k*T, E]
+        pos_flat = jnp.cumsum(rank_major, axis=0) * rank_major - 1.0
+        pos_rank = jnp.sum(pos_flat.reshape(k_r, t, e) * jnp.swapaxes(onehots, 0, 1),
+                           axis=-1).astype(jnp.int32)           # [k, T]
+        keep = pos_rank < c
+        slot = jax.nn.one_hot(jnp.where(keep, pos_rank, -1), c,
+                              dtype=jnp.float32)                # [k, T, C]; dropped -> 0
+        per_rank = jnp.swapaxes(onehots, 0, 1)[:, :, :, None] * slot[:, :, None, :]
+        dispatch = jnp.sum(per_rank, axis=0)                    # [T, E, C]
+        combine = jnp.sum(
+            per_rank * jnp.swapaxes(gate_probs, 0, 1)[:, :, None, None], axis=0)
 
         # Switch load-balance aux: E * sum_e (fraction routed) * (mean prob)
-        frac = jnp.mean(onehot, axis=0)
+        # — computed on FIRST choices for both k (the standard Switch form;
+        # GShard's variant likewise uses the top-1 assignment fraction)
+        frac = jnp.mean(onehots[:, 0], axis=0)
         mean_prob = jnp.mean(scores, axis=0)
         aux = e * jnp.sum(frac * mean_prob)
+
+        # router observability (surfaced by the train steps into their
+        # stats output): what fraction of routed assignments fell off the
+        # capacity cliff, and how hot the hottest expert ran relative to
+        # its capacity.  Scalars, so the sow costs nothing
+        assigned = jnp.sum(rank_major, axis=0)                  # [E]
+        self.sow("router_stats", "dropped_fraction",
+                 1.0 - jnp.sum(slot) / (k_r * t))
+        self.sow("router_stats", "max_expert_load",
+                 jnp.max(assigned) / c)
 
         # -- dispatch to experts ----------------------------------------------
         slots = jnp.einsum("tec,td->ecd", dispatch.astype(self.compute_dtype), xc)
@@ -144,24 +174,28 @@ class MoEClassifier(nn.Module):
     num_outputs: int = 10
     ep_axis: Optional[str] = None
     ep_size: int = 1
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         h = nn.Dense(self.model_dim, name="embed")(x)
         moe_out, aux = MoEMLP(num_experts=self.num_experts, model_dim=self.model_dim,
                               hidden_dim=self.hidden_dim, capacity=self.capacity,
-                              ep_axis=self.ep_axis, ep_size=self.ep_size, name="moe")(h)
+                              ep_axis=self.ep_axis, ep_size=self.ep_size,
+                              router_top_k=self.router_top_k, name="moe")(h)
         h = h + moe_out
         self.sow("aux_loss", "load_balance", aux)
         return nn.Dense(self.num_outputs, name="head")(h)
 
 
 def moe_classifier_spec(input_dim: int = 32, num_experts: int = 4, capacity: int = 64,
-                        num_outputs: int = 10, ep_axis: Optional[str] = None) -> ModelSpec:
+                        num_outputs: int = 10, ep_axis: Optional[str] = None,
+                        router_top_k: int = 1) -> ModelSpec:
     return ModelSpec(
         name="moe_mlp_classifier",
         config={"input_dim": input_dim, "num_experts": num_experts,
-                "capacity": capacity, "num_outputs": num_outputs, "ep_axis": ep_axis},
+                "capacity": capacity, "num_outputs": num_outputs, "ep_axis": ep_axis,
+                "router_top_k": router_top_k},
         input_shape=(input_dim,),
     )
 
@@ -175,6 +209,24 @@ def _moe_param_specs(params: Any, ep_axis: str):
         return P(ep_axis) if names & {"w_up", "w_down"} else P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _collect_router_stats(tree) -> Dict[str, list]:
+    """Walk a sown ``router_stats`` collection — nested {module_path:
+    {stat_name: (values...)}} dicts, one entry per MoE layer — and group
+    the leaf values by STAT NAME across layers."""
+    stats: Dict[str, list] = {}
+
+    def visit(node):
+        for key, val in dict(node).items():
+            if hasattr(val, "items"):
+                visit(val)
+            else:
+                vals = val if isinstance(val, (tuple, list)) else (val,)
+                stats.setdefault(key, []).extend(vals)
+
+    visit(tree)
+    return stats
 
 
 def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
@@ -195,15 +247,25 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
     def shard_fn(params, opt_state, x, y):
         def loss_fn(p):
             logits, variables = module_local.apply(
-                {"params": p}, x, mutable=["aux_loss"])
+                {"params": p}, x, mutable=["aux_loss", "router_stats"])
             ce = per_example_loss(logits, y)
             aux_leaves = jax.tree.leaves(variables.get("aux_loss", {}))
             aux = sum(aux_leaves) / len(aux_leaves) if aux_leaves else 0.0
             loss = ce + aux_weight * aux
             n = lax.psum(1, (dp_axis, ep_axis))
-            return lax.psum(loss, (dp_axis, ep_axis)) / n
+            return lax.psum(loss, (dp_axis, ep_axis)) / n, variables
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        (loss, variables), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # router observability: every sown counter, averaged over layers
+        # and shards (each shard routes its own tokens) — returned so the
+        # caller's training loop can watch drops/overflow without a second
+        # forward.  stats names follow the sow names in MoEMLP
+        n = lax.psum(1, (dp_axis, ep_axis))
+        stats = {
+            name: lax.psum(sum(vals) / len(vals), (dp_axis, ep_axis)) / n
+            for name, vals in _collect_router_stats(
+                variables.get("router_stats", {})).items()
+        }
         # sync each grad leaf down to its param's sharding: replicated
         # params need the cross-shard psum; expert slabs keep their ep
         # variance but still sum over dp (the same slab serves every dp row)
@@ -212,7 +274,7 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                 a for a in jax.typeof(g).vma if a not in jax.typeof(p).vma)) else g,
             grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        return optax.apply_updates(params, updates), opt_state, loss, stats
 
     def wrapped(params, opt_state, x, y):
         # specs resolved at trace time from the actual tree structures
@@ -221,7 +283,7 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         data_spec = P((dp_axis, ep_axis))  # batch split over all devices
         sharded = jax.shard_map(shard_fn, mesh=mesh,
                                 in_specs=(pspecs, ospecs, data_spec, data_spec),
-                                out_specs=(pspecs, ospecs, P()))
+                                out_specs=(pspecs, ospecs, P(), P()))
         return sharded(params, opt_state, x, y)
 
     return jax.jit(wrapped, donate_argnums=(0, 1))
@@ -230,10 +292,14 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
 def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                         mesh: Mesh, dp_axis: str = "dp", ep_axis: str = "ep",
                         aux_weight: float = 0.01) -> Callable:
-    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)`` over
-    a (dp, ep) mesh for classifier-shaped models: ``y`` one-hot.  Expert
-    weights sharded over ep (place state with ``moe_state_shardings``),
-    everything else replicated.
+    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss,
+    router_stats)`` over a (dp, ep) mesh for classifier-shaped models:
+    ``y`` one-hot.  Expert weights sharded over ep (place state with
+    ``moe_state_shardings``), everything else replicated.  ``router_stats``
+    is a dict of scalars averaged over MoE layers and shards —
+    ``dropped_fraction`` (routed assignments lost to the capacity cliff)
+    and ``max_expert_load`` (hottest expert's assignments / capacity) —
+    for the training loop's metrics.
     """
     return _make_moe_step(
         spec, optimizer, mesh, dp_axis, ep_axis, aux_weight,
@@ -248,8 +314,10 @@ def make_moe_lm_train_step(spec: ModelSpec, optimizer: optax.GradientTransformat
     """(dp x ep) training step for a MoE TransformerLM (``moe_experts`` set
     in the spec): tokens/targets [B, L] int32 with B sharded over both
     axes, Switch FFN experts sharded over ep, per-block load-balance aux
-    losses averaged into the objective.  v1 scope: MoE composes with dp/ep
-    here (tp/sp belong to the dense lm step in parallel/lm.py).
+    losses averaged into the objective.  Returns ``(params, opt_state,
+    loss, router_stats)`` — see :func:`make_moe_train_step` for the stats
+    dict.  v1 scope: MoE composes with dp/ep here (tp/sp belong to the
+    dense lm step in parallel/lm.py).
     """
     return _make_moe_step(
         spec, optimizer, mesh, dp_axis, ep_axis, aux_weight,

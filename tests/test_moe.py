@@ -106,10 +106,111 @@ def test_moe_train_step_learns_dp_ep():
 
     losses = []
     for _ in range(30):
-        params, opt_state, loss = step(params, opt_state, xd, yd)
+        params, opt_state, loss, stats = step(params, opt_state, xd, yd)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+    # router observability comes back with every step
+    assert set(stats) == {"dropped_fraction", "max_expert_load"}
+    assert 0.0 <= float(stats["dropped_fraction"]) <= 1.0
+    assert float(stats["max_expert_load"]) >= 0.0
+
+
+def _dense_routing_oracle(x, params, capacity, top_k):
+    """Numpy re-derivation of the routed MoE forward: softmax router,
+    top-k choices with rank-priority seating, gelu expert MLPs, gate-
+    weighted combine.  Independent of the einsum/one-hot implementation."""
+    import scipy.special as sp
+
+    x64 = np.asarray(x, np.float64)
+    router = np.asarray(params["router"], np.float64)
+    w_up = np.asarray(params["w_up"], np.float64)
+    w_down = np.asarray(params["w_down"], np.float64)
+    scores = sp.softmax(x64 @ router, axis=-1)
+    t, e = scores.shape
+    order = np.argsort(-scores, axis=-1)[:, :top_k]   # [T, k]
+    gates = np.take_along_axis(scores, order, axis=-1)
+    if top_k > 1:
+        gates = gates / gates.sum(-1, keepdims=True)
+    counts = np.zeros(e, np.int64)
+    out = np.zeros_like(x64)
+    seated = []  # (token, expert, gate), rank-major like the kernel
+    for r in range(top_k):
+        for tok in range(t):
+            exp = order[tok, r]
+            if counts[exp] < capacity:
+                seated.append((tok, exp, gates[tok, r]))
+                counts[exp] += 1
+    for tok, exp, g in seated:
+        h = x64[tok] @ w_up[exp]
+        # flax nn.gelu default: the tanh approximation
+        h = 0.5 * h * (1.0 + np.tanh(np.sqrt(2.0 / np.pi)
+                                     * (h + 0.044715 * h ** 3)))
+        out[tok] += g * (h @ w_down[exp])
+    return out
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_routing_matches_dense_oracle(top_k):
+    """The one-hot einsum dispatch equals a loop-and-gather oracle for
+    both Switch (k=1) and top-2 routing, including capacity drops with
+    rank priority."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(24, D)), dtype=jnp.float32)
+    mod = MoEMLP(num_experts=E, model_dim=D, hidden_dim=F, capacity=5,
+                 router_top_k=top_k, compute_dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(2), x)["params"]
+    got, _ = mod.apply({"params": params}, x)
+    want = _dense_routing_oracle(x, params, capacity=5, top_k=top_k)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_top2_expert_parallel_matches_single_device(tokens_and_params):
+    """The top-2 ep=4 all_to_all path equals all-experts-local — routing
+    depends only on (params, tokens), so sharding must not change it."""
+    x, _ = tokens_and_params
+    mod1 = MoEMLP(num_experts=E, model_dim=D, hidden_dim=F, capacity=T,
+                  router_top_k=2, compute_dtype=jnp.float32)
+    params = mod1.init(jax.random.PRNGKey(1), x)["params"]
+    ref, _ = mod1.apply({"params": params}, x)
+
+    mesh = create_nd_mesh((4,), ("ep",))
+    mod4 = MoEMLP(num_experts=E, model_dim=D, hidden_dim=F, capacity=T,
+                  router_top_k=2, ep_axis="ep", ep_size=4,
+                  compute_dtype=jnp.float32)
+    pspecs = _moe_param_specs(params, "ep")
+
+    def fn(params, x):
+        out, _ = mod4.apply({"params": params}, x)
+        return out
+
+    sharded = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(pspecs, P("ep")),
+                                    out_specs=P("ep")))
+    psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                       is_leaf=lambda v: isinstance(v, P))
+    out = sharded(jax.device_put(params, psh),
+                  jax.device_put(x, NamedSharding(mesh, P("ep"))))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_router_counters_see_forced_overflow():
+    """Route everything at one expert with tiny capacity: the sown
+    counters must report the drops and the hot expert's load."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(8, D)) + 2.0, dtype=jnp.float32)
+    mod = _moe(capacity=2)
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 1e3
+    params = dict(params, router=jnp.asarray(router))
+    (out, aux), variables = mod.apply({"params": params}, x,
+                                      mutable=["router_stats"])
+    stats = variables["router_stats"]
+    dropped = float(jax.tree.leaves(stats["dropped_fraction"])[0])
+    load = float(jax.tree.leaves(stats["max_expert_load"])[0])
+    # 8 tokens -> expert 0, capacity 2: 6 of 8 dropped, load 8/2 = 4
+    assert dropped == pytest.approx(6 / 8)
+    assert load == pytest.approx(4.0)
 
 
 def test_moe_classifier_spec_roundtrip_and_predict():
@@ -159,10 +260,11 @@ def test_moe_transformer_lm_learns_dp_ep():
 
     losses = []
     for _ in range(25):
-        params, opt_state, loss = step(params, opt_state, tok_d, tgt_d)
+        params, opt_state, loss, stats = step(params, opt_state, tok_d, tgt_d)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+    assert 0.0 <= float(stats["dropped_fraction"]) <= 1.0
 
 
 def test_moe_lm_single_device_forward():
