@@ -101,14 +101,15 @@ class MoEMLP(nn.Module):
         # second choice never evicts someone's first.  The rank-major
         # [k*T, E] cumsum implements exactly that order; beyond-capacity
         # assignments drop (residual path, standard Switch behavior)
-        rank_major = jnp.swapaxes(onehots, 0, 1).reshape(k_r * t, e)  # [k*T, E]
+        oh_rank = jnp.swapaxes(onehots, 0, 1)                   # [k, T, E], rank-major
+        rank_major = oh_rank.reshape(k_r * t, e)                # [k*T, E]
         pos_flat = jnp.cumsum(rank_major, axis=0) * rank_major - 1.0
-        pos_rank = jnp.sum(pos_flat.reshape(k_r, t, e) * jnp.swapaxes(onehots, 0, 1),
+        pos_rank = jnp.sum(pos_flat.reshape(k_r, t, e) * oh_rank,
                            axis=-1).astype(jnp.int32)           # [k, T]
         keep = pos_rank < c
         slot = jax.nn.one_hot(jnp.where(keep, pos_rank, -1), c,
                               dtype=jnp.float32)                # [k, T, C]; dropped -> 0
-        per_rank = jnp.swapaxes(onehots, 0, 1)[:, :, :, None] * slot[:, :, None, :]
+        per_rank = oh_rank[:, :, :, None] * slot[:, :, None, :]
         dispatch = jnp.sum(per_rank, axis=0)                    # [T, E, C]
         combine = jnp.sum(
             per_rank * jnp.swapaxes(gate_probs, 0, 1)[:, :, None, None], axis=0)

@@ -1,17 +1,23 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` axis.
+"""Pipeline parallelism: microbatch schedules over a ``pp`` axis.
 
 No reference counterpart (the reference is data-parallel only, SURVEY.md
-§2.13) — TPU-native headroom.  The design leans on two XLA facts:
+§2.13) — TPU-native headroom.  Two schedules share one substrate (the
+rotation: each rank applies its resident stage of ``num_layers / pp``
+transformer blocks to its current buffer, then ``lax.ppermute``s
+activations one hop):
 
-1. A pipeline is just a rotation: each rank applies its resident stage
-   (``num_layers / pp`` transformer blocks) to its current buffer, then
-   ``lax.ppermute``s the activations one hop to the next rank.  Rank 0
-   feeds a fresh microbatch each tick; the last rank collects finished
-   microbatches.  ``M + pp - 1`` ticks drain ``M`` microbatches.
-2. The backward schedule is NOT hand-written: differentiating through the
-   tick scan reverses every ppermute (collective adjoints), which IS the
-   backward pipeline.  ``jax.checkpoint`` around the stage keeps the
-   per-tick residuals O(microbatch), the standard remat trade.
+1. **GPipe** — all-forward-then-all-backward.  Rank 0 feeds a fresh
+   microbatch each tick; the last rank collects finished microbatches;
+   ``M + pp - 1`` ticks drain ``M``.  The backward schedule is NOT
+   hand-written: differentiating through the tick scan reverses every
+   ppermute (collective adjoints), which IS the backward pipeline.
+   ``jax.checkpoint`` around the stage keeps per-tick residuals
+   O(microbatch), but the scan's residuals grow O(M) overall.
+2. **1F1B** (``schedule="1f1b"``) — hand-scheduled: each cycle runs one
+   forward AND one backward unit per rank, cotangents hop up a reverse
+   ppermute ring, and backward units re-derive their stage vjp from a
+   ``2*pp - 1``-slot input ring — resident activations O(pp) regardless
+   of M.  Same gradients (parity-tested), same 2(pp-1)-unit bubble.
 
 Layout: block params are stacked to [num_layers, ...] and sharded over pp
 on the leading axis (each rank holds its stage's slab); embedding/unembed/
@@ -70,17 +76,36 @@ def pp_param_specs(outer: Dict[str, Any], blocks: Any, pp_axis: str):
 
 def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                        mesh: Mesh, num_microbatches: int,
-                       dp_axis: str = "dp", pp_axis: str = "pp") -> Callable:
+                       dp_axis: str = "dp", pp_axis: str = "pp",
+                       schedule: str = "gpipe") -> Callable:
     """Build a jitted ((outer, blocks), opt_state, tokens, targets) ->
     ((outer, blocks), opt_state, loss) pipeline-parallel training step.
 
     ``tokens``/``targets`` are [B, L] with B sharded over dp (and B a
     multiple of ``num_microbatches`` per dp shard); block params must be
     placed with ``pp_state_shardings``.
+
+    ``schedule``:
+
+    - ``"gpipe"`` — all-forward-then-all-backward; the backward pipeline
+      comes free from differentiating the tick scan (collective
+      adjoints).  Activation residuals grow with the number of
+      microbatches M: O(M) stage boundaries live across the backward.
+    - ``"1f1b"`` — hand-scheduled one-forward-one-backward: each cycle
+      every rank runs one forward unit AND one backward unit (the
+      backward re-derives its stage vjp from a stored stage INPUT), so
+      at most ``2*pp - 1`` microbatch activations are ever resident —
+      O(pp), independent of M.  The gradient math is identical (parity
+      tested); the BUBBLE is also identical (2(pp-1) idle units either
+      way — non-interleaved 1F1B trades nothing for its memory bound).
+      Pick it when M must grow (long sequences / small microbatches)
+      and GPipe's O(M) residuals would not fit HBM.
     """
     if spec.config.get("moe_experts"):
         raise ValueError("MoE FFN does not compose with pipeline parallelism "
                          "(v1); use make_moe_lm_train_step or a dense spec")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
     pp = mesh.shape[pp_axis]
     num_layers = spec.config["num_layers"]
     if num_layers % pp:
@@ -104,6 +129,141 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         x, _ = lax.scan(one, x, stage_params)
         return x
 
+    def vary(z):
+        """Promote to varying over (dp, pp) — both schedules' buffers need
+        the full vma before mixing with per-shard data."""
+        missing = tuple(a for a in (dp_axis, pp_axis)
+                        if a not in jax.typeof(z).vma)
+        return lax.pcast(z, missing, to="varying") if missing else z
+
+    down_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    up_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def shard_fn_1f1b(params, opt_state, tokens, targets):
+        """One-forward-one-backward: cycle c runs the forward of
+        microbatch ``c - rank`` and the backward of microbatch
+        ``c - 2(pp-1) + rank`` on every rank, with activations hopping
+        down (ppermute) and cotangents hopping up each cycle.
+
+        No autodiff crosses the cycle scan: backward units recompute
+        their stage vjp from the stage INPUT stored in a ``2*pp - 1``
+        slot ring (an input stored at cycle ``b + r`` is consumed at
+        ``b + 2(pp-1) - r``, span <= 2(pp-1) < ring), and parameter
+        gradients accumulate explicitly.  The last rank's backward unit
+        folds the head + CE vjp into the same grad call via a
+        ``where``-selected scalar (gradient of ``where`` masks each
+        branch, so non-last ranks contribute exactly the cotangent
+        chain and zero head gradient).
+        """
+        outer, blocks = params
+        my = lax.axis_index(pp_axis)
+        is_last = my == pp - 1
+        b, l = tokens.shape
+        m = num_microbatches
+        mb = b // m
+        x_emb = module.apply({"params": outer}, tokens, method="embed_tokens")
+        e = x_emb.shape[-1]
+        x_emb = vary(x_emb.reshape(m, mb, l, e))
+        tgt_mb = vary(targets.reshape(m, mb, l))
+
+        def unit_scalar(blocks_, outer_, x_in, cot_in, tgt_1mb, last_flag):
+            y = stage_apply(blocks_, x_in)
+            logits = module.apply({"params": outer_}, y, method="head")
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), tgt_1mb.astype(jnp.int32))
+            ce_term = jnp.sum(ce[:, :-1])
+            chain_term = jnp.sum((y * cot_in).astype(jnp.float32))
+            return jnp.where(last_flag, ce_term, chain_term)
+
+        unit_grad = jax.value_and_grad(unit_scalar, argnums=(0, 1, 2))
+
+        ring = 2 * pp - 1
+        cycles = m + 2 * (pp - 1)
+        zeros_f32 = lambda tree: jax.tree.map(
+            lambda a: vary(jnp.zeros(a.shape, jnp.float32)), tree)
+        carry0 = (
+            vary(jnp.zeros((mb, l, e), x_emb.dtype)),          # fwd_buf
+            vary(jnp.zeros((mb, l, e), x_emb.dtype)),          # cot_buf
+            vary(jnp.zeros((ring, mb, l, e), x_emb.dtype)),    # act ring
+            zeros_f32(blocks),                                 # grad accum
+            zeros_f32(outer),                                  # head grad accum
+            vary(jnp.zeros((m, mb, l, e), x_emb.dtype)),       # d x_emb
+            vary(jnp.zeros((), jnp.float32)),                  # loss accum
+        )
+
+        def cycle(carry, c):
+            fwd_buf, cot_buf, acts, g_blocks, g_outer, dxemb, loss = carry
+            # ---- forward unit: microbatch c - my -------------------------
+            feed = lax.dynamic_index_in_dim(x_emb, jnp.clip(c, 0, m - 1), 0,
+                                            keepdims=False)
+            x_in_f = jnp.where(my == 0, feed, fwd_buf)
+            y_f = stage_apply(blocks, x_in_f)
+            acts = lax.dynamic_update_index_in_dim(acts, x_in_f, c % ring, 0)
+            # ---- backward unit: microbatch c - 2(pp-1) + my --------------
+            b_idx = c - 2 * (pp - 1) + my
+            b_valid = jnp.logical_and(b_idx >= 0, b_idx < m)
+            stored_at = b_idx + my  # its forward cycle on this rank
+            x_in_b = lax.dynamic_index_in_dim(
+                acts, jnp.clip(stored_at, 0, cycles) % ring, 0, keepdims=False)
+            tgt_b = lax.dynamic_index_in_dim(tgt_mb, jnp.clip(b_idx, 0, m - 1),
+                                             0, keepdims=False)
+            val, (gb, go, gx) = unit_grad(blocks, outer, x_in_b, cot_buf,
+                                          tgt_b, is_last)
+            mask = b_valid.astype(jnp.float32)
+            g_blocks = jax.tree.map(lambda acc, g: acc + mask * g, g_blocks, gb)
+            g_outer = jax.tree.map(lambda acc, g: acc + mask * g, g_outer, go)
+            loss = loss + jnp.where(jnp.logical_and(b_valid, is_last), val, 0.0)
+            # rank 0's input cotangent is the embedding cotangent for mb b
+            slot = jnp.clip(b_idx, 0, m - 1)
+            cur = lax.dynamic_index_in_dim(dxemb, slot, 0, keepdims=False)
+            keep0 = jnp.logical_and(b_valid, my == 0)
+            dxemb = lax.dynamic_update_index_in_dim(
+                dxemb, jnp.where(keep0, gx.astype(dxemb.dtype), cur), slot, 0)
+            # ---- communication: activations down, cotangents up ----------
+            fwd_buf = lax.ppermute(y_f, pp_axis, down_perm)
+            cot_buf = lax.ppermute(gx.astype(x_emb.dtype), pp_axis, up_perm)
+            return (fwd_buf, cot_buf, acts, g_blocks, g_outer, dxemb, loss), None
+
+        (carry_out, _) = lax.scan(cycle, carry0, jnp.arange(cycles))
+        _, _, _, g_blocks, g_outer_head, dxemb, loss_sum = carry_out
+
+        # normalization matching the GPipe loss: global token count over dp
+        wcount = lax.pcast(jnp.float32(b * (l - 1)), (dp_axis,), to="varying")
+        denom = lax.psum(wcount, (dp_axis,))
+        # The unit grads w.r.t. dp-UNVARYING params already carry the
+        # cross-dp sum: shard_map's autodiff inserts a psum as the adjoint
+        # of the implicit unvarying->varying broadcast (the same mechanism
+        # that dp-syncs the GPipe schedule's autodiff grads).  The
+        # accumulators are therefore value-identical across dp and only
+        # TYPED varying (they were initialized with a pcast); pmean
+        # demotes the type without double-counting — a psum here measured
+        # exactly dp x too large.
+        g_blocks = jax.tree.map(lambda g: lax.pmean(g, (dp_axis,)) / denom,
+                                g_blocks)
+        # head-side outer grads live on the last rank; embed-side come from
+        # vjp'ing the (pp-replicated) embedding with the collected rank-0
+        # cotangents — both sum over dp like any replicated leaf
+        g_outer_head = jax.tree.map(
+            lambda g: lax.psum(jnp.where(is_last, g, 0.0), (pp_axis,)),
+            g_outer_head)
+        dxemb = lax.psum(jnp.where(my == 0, dxemb, jnp.zeros_like(dxemb)),
+                         (pp_axis,))
+        _, vjp_embed = jax.vjp(
+            lambda o: module.apply({"params": o}, tokens,
+                                   method="embed_tokens").reshape(m, mb, l, e),
+            outer)
+        (g_embed,) = vjp_embed(dxemb)
+        g_outer = jax.tree.map(
+            lambda h, ge: lax.pmean(h + ge, (dp_axis,)) / denom,
+            g_outer_head, jax.tree.map(lambda x: x.astype(jnp.float32), g_embed))
+        loss = lax.psum(jnp.where(is_last, loss_sum, 0.0),
+                        (dp_axis, pp_axis)) / denom
+
+        grads = (g_outer, g_blocks)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
     def shard_fn(params, opt_state, tokens, targets):
         outer, blocks = params
         my = lax.axis_index(pp_axis)
@@ -124,14 +284,7 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
             # embed_tokens/head never touch them.
             x_emb = module.apply({"params": outer}, toks_mb.reshape(b, l),
                                  method="embed_tokens")
-            x_emb = x_emb.reshape(num_microbatches, mb, l, -1)
-
-            def vary(z):
-                missing = tuple(a for a in (dp_axis, pp_axis)
-                                if a not in jax.typeof(z).vma)
-                return lax.pcast(z, missing, to="varying") if missing else z
-
-            x_emb = vary(x_emb)
+            x_emb = vary(x_emb.reshape(num_microbatches, mb, l, -1))
             e = x_emb.shape[-1]
             ticks = num_microbatches + pp - 1
             buf0 = vary(jnp.zeros((mb, l, e), x_emb.dtype))
@@ -151,8 +304,7 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                 new_outs = lax.dynamic_update_index_in_dim(
                     outs, y, jnp.clip(done_idx, 0, num_microbatches - 1), 0)
                 outs = jnp.where(valid, new_outs, outs)
-                perm = [(i, (i + 1) % pp) for i in range(pp)]
-                buf = lax.ppermute(y, pp_axis, perm)
+                buf = lax.ppermute(y, pp_axis, down_perm)
                 return (buf, outs), None
 
             (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
@@ -182,7 +334,7 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         jax.eval_shape(optimizer.init, (outer_t, blocks_t)))
     data_spec = P(dp_axis)
     sharded = jax.shard_map(
-        shard_fn,
+        shard_fn_1f1b if schedule == "1f1b" else shard_fn,
         mesh=mesh,
         in_specs=(pspecs, ospecs, data_spec, data_spec),
         out_specs=(pspecs, ospecs, P()),

@@ -73,6 +73,99 @@ def test_pp_step_matches_single_device():
                                    err_msg=f"param mismatch at {jax.tree_util.keystr(ka)}")
 
 
+def test_1f1b_matches_gpipe_and_single_device():
+    """The hand-scheduled 1F1B backward must produce the same loss and
+    updated params as GPipe's autodiff backward AND the single-device
+    reference — the schedules differ only in memory shape."""
+    mesh = create_nd_mesh((2, 4), ("dp", "pp"))
+    spec = _spec(num_layers=4)
+    model = Model.init(spec, seed=0)
+    opt = optax.sgd(0.1)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+    targets = shift_targets(tokens)
+
+    module = spec.build()
+
+    def loss_fn(params, tok, tgt):
+        logits = module.apply({"params": params}, tok)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgt)
+        return ce[:, :-1].mean()
+
+    loss_ref, grads = jax.value_and_grad(loss_fn)(model.params, tokens, targets)
+    updates, _ = opt.update(grads, opt.init(model.params), model.params)
+    params_ref = optax.apply_updates(model.params, updates)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    dsh = NamedSharding(mesh, P("dp"))
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        # fresh buffers each schedule: the donated step may alias (and
+        # delete) the arrays device_put was handed
+        outer, blocks = split_block_params(
+            jax.tree.map(jnp.array, model.params))
+        step = make_pp_train_step(spec, opt, mesh, num_microbatches=4,
+                                  schedule=schedule)
+        psh, osh = pp_state_shardings(mesh, opt, outer, blocks)
+        params = jax.device_put((outer, blocks), psh)
+        opt_state = jax.device_put(opt.init((outer, blocks)), osh)
+        (outer2, blocks2), _, loss = step(params, opt_state,
+                                          jax.device_put(tokens, dsh),
+                                          jax.device_put(targets, dsh))
+        results[schedule] = (float(loss), merge_block_params(
+            jax.tree.map(np.asarray, outer2), jax.tree.map(np.asarray, blocks2)))
+
+    for schedule, (loss, merged) in results.items():
+        np.testing.assert_allclose(loss, float(loss_ref), rtol=1e-3,
+                                   err_msg=f"{schedule} loss vs single-device")
+        for (ka, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(merged),
+                                   jax.tree_util.tree_leaves_with_path(params_ref)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-3,
+                err_msg=f"{schedule} param mismatch at {jax.tree_util.keystr(ka)}")
+    # and against each other (same math, different bf16 accumulation
+    # order — the schedules chain cotangents through different sequences,
+    # so they are no closer to each other than to the f32 reference)
+    for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(results["gpipe"][1]),
+            jax.tree_util.tree_leaves_with_path(results["1f1b"][1])):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3,
+            err_msg=f"gpipe vs 1f1b mismatch at {jax.tree_util.keystr(ka)}")
+
+
+def test_1f1b_learns_and_rejects_unknown_schedule():
+    import pytest
+
+    mesh = create_nd_mesh((2, 2), ("dp", "pp"))
+    spec = _spec(num_layers=2)
+    with pytest.raises(ValueError, match="schedule"):
+        make_pp_train_step(spec, optax.sgd(0.1), mesh, num_microbatches=2,
+                           schedule="zigzag")
+    model = Model.init(spec, seed=1)
+    opt = optax.adam(1e-2)
+    outer, blocks = split_block_params(model.params)
+    step = make_pp_train_step(spec, opt, mesh, num_microbatches=2,
+                              schedule="1f1b")
+    psh, osh = pp_state_shardings(mesh, opt, outer, blocks)
+    params = jax.device_put((outer, blocks), psh)
+    opt_state = jax.device_put(opt.init((outer, blocks)), osh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    dsh = NamedSharding(mesh, P("dp"))
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 8, size=(8, 16)).astype(np.int32)
+    targets = shift_targets(tokens)
+    tok_d, tgt_d = jax.device_put(tokens, dsh), jax.device_put(targets, dsh)
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tok_d, tgt_d)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_pp_step_learns():
     mesh = create_nd_mesh((2, 2), ("dp", "pp"))
     spec = _spec(num_layers=2)
