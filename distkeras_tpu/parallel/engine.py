@@ -339,18 +339,25 @@ class WindowEngine:
                 NamedSharding(self.mesh, P()), keys)
         return jnp.asarray(keys)
 
-    def _place_data(self, xs, ys):
-        """Host chunk -> mesh-sharded device arrays; in a multi-process
-        run every process passes the same GLOBAL chunk and contributes the
-        batch columns its devices own (exact parity with the
-        single-process replica->rows assignment, which a contiguous
-        dataset-level shard would not give)."""
+    def place_data(self, xs, ys):
+        """Host chunk -> mesh-sharded device arrays (asynchronous issue;
+        public so trainers can double-buffer via ``prefetch_to_device``);
+        in a multi-process run every process passes the same GLOBAL chunk
+        and contributes the batch columns its devices own (exact parity
+        with the single-process replica->rows assignment, which a
+        contiguous dataset-level shard would not give).  Already-placed
+        ``jax.Array`` inputs pass through untouched, so ``run_epoch``
+        accepts either form."""
+        if isinstance(xs, jax.Array) and isinstance(ys, jax.Array):
+            return xs, ys
         sharding = self.data_sharding()
         if jax.process_count() > 1:
             lo, hi = self._local_batch_range(xs.shape[2])
             return (jax.make_array_from_process_local_data(sharding, xs[:, :, lo:hi]),
                     jax.make_array_from_process_local_data(sharding, ys[:, :, lo:hi]))
         return jax.device_put(xs, sharding), jax.device_put(ys, sharding)
+
+    _place_data = place_data  # backward-compatible alias
 
     def _local_batch_range(self, global_batch: int):
         """Global-batch column range owned by this process's devices (the

@@ -154,6 +154,20 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         ``where``-selected scalar (gradient of ``where`` masks each
         branch, so non-last ranks contribute exactly the cotangent
         chain and zero head gradient).
+
+        Resident activations really are O(pp): the embedding runs PER
+        CYCLE on the current microbatch's tokens (the full-epoch token
+        ids are the only O(M) array — int32, model_dim-times smaller
+        than activations), and rank 0's embedding cotangent folds into
+        the gradient accumulator in the same cycle via an inline vjp
+        instead of being collected into an O(M) buffer.
+
+        Params enter the cycle computation pcast to (dp, pp)-VARYING, so
+        every unit grad is shard-local (no per-cycle implicit psum from
+        the unvarying->varying adjoint); the single demotion to each
+        param's sharding happens once after the scan — where the psum
+        over pp neatly SUMS the outer tree's two owners (rank 0's
+        embedding part, the last rank's head part).
         """
         outer, blocks = params
         my = lax.axis_index(pp_axis)
@@ -161,10 +175,16 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         b, l = tokens.shape
         m = num_microbatches
         mb = b // m
-        x_emb = module.apply({"params": outer}, tokens, method="embed_tokens")
-        e = x_emb.shape[-1]
-        x_emb = vary(x_emb.reshape(m, mb, l, e))
+        e = cfg["model_dim"]
+        edtype = jnp.dtype(cdtype)
+        tok_mb = vary(tokens.reshape(m, mb, l))
         tgt_mb = vary(targets.reshape(m, mb, l))
+        outer_v = jax.tree.map(vary, outer)
+        blocks_v = jax.tree.map(vary, blocks)
+
+        def embed(outer_, tok_1mb):
+            return module.apply({"params": outer_}, tok_1mb,
+                                method="embed_tokens")
 
         def unit_scalar(blocks_, outer_, x_in, cot_in, tgt_1mb, last_flag):
             y = stage_apply(blocks_, x_in)
@@ -182,22 +202,21 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         zeros_f32 = lambda tree: jax.tree.map(
             lambda a: vary(jnp.zeros(a.shape, jnp.float32)), tree)
         carry0 = (
-            vary(jnp.zeros((mb, l, e), x_emb.dtype)),          # fwd_buf
-            vary(jnp.zeros((mb, l, e), x_emb.dtype)),          # cot_buf
-            vary(jnp.zeros((ring, mb, l, e), x_emb.dtype)),    # act ring
+            vary(jnp.zeros((mb, l, e), edtype)),               # fwd_buf
+            vary(jnp.zeros((mb, l, e), edtype)),               # cot_buf
+            vary(jnp.zeros((ring, mb, l, e), edtype)),         # act ring
             zeros_f32(blocks),                                 # grad accum
-            zeros_f32(outer),                                  # head grad accum
-            vary(jnp.zeros((m, mb, l, e), x_emb.dtype)),       # d x_emb
+            zeros_f32(outer),                                  # outer grad accum
             vary(jnp.zeros((), jnp.float32)),                  # loss accum
         )
 
         def cycle(carry, c):
-            fwd_buf, cot_buf, acts, g_blocks, g_outer, dxemb, loss = carry
+            fwd_buf, cot_buf, acts, g_blocks, g_outer, loss = carry
             # ---- forward unit: microbatch c - my -------------------------
-            feed = lax.dynamic_index_in_dim(x_emb, jnp.clip(c, 0, m - 1), 0,
-                                            keepdims=False)
-            x_in_f = jnp.where(my == 0, feed, fwd_buf)
-            y_f = stage_apply(blocks, x_in_f)
+            feed = embed(outer_v, lax.dynamic_index_in_dim(
+                tok_mb, jnp.clip(c, 0, m - 1), 0, keepdims=False))
+            x_in_f = jnp.where(my == 0, feed.astype(edtype), fwd_buf)
+            y_f = stage_apply(blocks_v, x_in_f)
             acts = lax.dynamic_update_index_in_dim(acts, x_in_f, c % ring, 0)
             # ---- backward unit: microbatch c - 2(pp-1) + my --------------
             b_idx = c - 2 * (pp - 1) + my
@@ -207,57 +226,45 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                 acts, jnp.clip(stored_at, 0, cycles) % ring, 0, keepdims=False)
             tgt_b = lax.dynamic_index_in_dim(tgt_mb, jnp.clip(b_idx, 0, m - 1),
                                              0, keepdims=False)
-            val, (gb, go, gx) = unit_grad(blocks, outer, x_in_b, cot_buf,
+            val, (gb, go, gx) = unit_grad(blocks_v, outer_v, x_in_b, cot_buf,
                                           tgt_b, is_last)
             mask = b_valid.astype(jnp.float32)
-            g_blocks = jax.tree.map(lambda acc, g: acc + mask * g, g_blocks, gb)
-            g_outer = jax.tree.map(lambda acc, g: acc + mask * g, g_outer, go)
-            loss = loss + jnp.where(jnp.logical_and(b_valid, is_last), val, 0.0)
-            # rank 0's input cotangent is the embedding cotangent for mb b
-            slot = jnp.clip(b_idx, 0, m - 1)
-            cur = lax.dynamic_index_in_dim(dxemb, slot, 0, keepdims=False)
+            # rank 0's input cotangent is the embedding cotangent for mb b:
+            # fold it into the outer grads NOW (inline vjp over one
+            # microbatch) instead of collecting an O(M) cotangent buffer
+            tok_b = lax.dynamic_index_in_dim(tok_mb, jnp.clip(b_idx, 0, m - 1),
+                                             0, keepdims=False)
             keep0 = jnp.logical_and(b_valid, my == 0)
-            dxemb = lax.dynamic_update_index_in_dim(
-                dxemb, jnp.where(keep0, gx.astype(dxemb.dtype), cur), slot, 0)
+            ggx = jnp.where(keep0, gx, jnp.zeros_like(gx))
+            _, vjp_embed = jax.vjp(lambda o: embed(o, tok_b), outer_v)
+            (ge,) = vjp_embed(ggx.astype(feed.dtype))
+            g_blocks = jax.tree.map(lambda acc, g: acc + mask * g, g_blocks, gb)
+            g_outer = jax.tree.map(
+                lambda acc, g1, g2: acc + mask * g1 + g2.astype(jnp.float32),
+                g_outer, go, ge)
+            loss = loss + jnp.where(jnp.logical_and(b_valid, is_last), val, 0.0)
             # ---- communication: activations down, cotangents up ----------
             fwd_buf = lax.ppermute(y_f, pp_axis, down_perm)
-            cot_buf = lax.ppermute(gx.astype(x_emb.dtype), pp_axis, up_perm)
-            return (fwd_buf, cot_buf, acts, g_blocks, g_outer, dxemb, loss), None
+            cot_buf = lax.ppermute(gx.astype(edtype), pp_axis, up_perm)
+            return (fwd_buf, cot_buf, acts, g_blocks, g_outer, loss), None
 
         (carry_out, _) = lax.scan(cycle, carry0, jnp.arange(cycles))
-        _, _, _, g_blocks, g_outer_head, dxemb, loss_sum = carry_out
+        _, _, _, g_blocks, g_outer_acc, loss_sum = carry_out
 
         # normalization matching the GPipe loss: global token count over dp
         wcount = lax.pcast(jnp.float32(b * (l - 1)), (dp_axis,), to="varying")
         denom = lax.psum(wcount, (dp_axis,))
-        # The unit grads w.r.t. dp-UNVARYING params already carry the
-        # cross-dp sum: shard_map's autodiff inserts a psum as the adjoint
-        # of the implicit unvarying->varying broadcast (the same mechanism
-        # that dp-syncs the GPipe schedule's autodiff grads).  The
-        # accumulators are therefore value-identical across dp and only
-        # TYPED varying (they were initialized with a pcast); pmean
-        # demotes the type without double-counting — a psum here measured
-        # exactly dp x too large.
-        g_blocks = jax.tree.map(lambda g: lax.pmean(g, (dp_axis,)) / denom,
+        # grads accumulated SHARD-LOCALLY (params entered varying): one
+        # explicit demotion to each param's sharding.  blocks are
+        # pp-sharded dp-replicated -> sum over dp only; the outer tree's
+        # two contributions live on different ranks (embedding on rank 0,
+        # head on the last rank, zero elsewhere by masking), so the psum
+        # over pp both combines them and replicates the result
+        g_blocks = jax.tree.map(lambda g: lax.psum(g, (dp_axis,)) / denom,
                                 g_blocks)
-        # head-side outer grads live on the last rank; embed-side come from
-        # vjp'ing the (pp-replicated) embedding with the collected rank-0
-        # cotangents — both sum over dp like any replicated leaf
-        g_outer_head = jax.tree.map(
-            lambda g: lax.psum(jnp.where(is_last, g, 0.0), (pp_axis,)),
-            g_outer_head)
-        dxemb = lax.psum(jnp.where(my == 0, dxemb, jnp.zeros_like(dxemb)),
-                         (pp_axis,))
-        _, vjp_embed = jax.vjp(
-            lambda o: module.apply({"params": o}, tokens,
-                                   method="embed_tokens").reshape(m, mb, l, e),
-            outer)
-        (g_embed,) = vjp_embed(dxemb)
         g_outer = jax.tree.map(
-            lambda h, ge: lax.pmean(h + ge, (dp_axis,)) / denom,
-            g_outer_head, jax.tree.map(lambda x: x.astype(jnp.float32), g_embed))
-        loss = lax.psum(jnp.where(is_last, loss_sum, 0.0),
-                        (dp_axis, pp_axis)) / denom
+            lambda g: lax.psum(g, (dp_axis, pp_axis)) / denom, g_outer_acc)
+        loss = lax.psum(loss_sum, (dp_axis, pp_axis)) / denom
 
         grads = (g_outer, g_blocks)
         updates, opt_state = optimizer.update(grads, opt_state, params)

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.checkpoint import Checkpointer
-from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.dataset import Dataset, prefetch_to_device
 from distkeras_tpu.models.base import Model, ModelSpec
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
@@ -315,23 +315,28 @@ class SingleTrainer(Trainer):
                 t_epoch = time.time()
                 samples = 0
                 ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
-                chunk_idx = 0
-                for chunk in ds.chunked_epoch(self.batch_size,
-                                              [self.features_col, self.label_col],
-                                              window=1, chunk_windows=self.chunk_windows):
-                    xs = chunk[self.features_col].squeeze(1)  # [num_batches, bs, ...]
-                    ys = chunk[self.label_col].squeeze(1)
+
+                def place(chunk):
+                    # async H2D issue only — prefetch_to_device overlaps the
+                    # next chunk's copy-in with this chunk's training
+                    return (jnp.asarray(chunk[self.features_col].squeeze(1)),
+                            jnp.asarray(chunk[self.label_col].squeeze(1)))
+
+                placed = prefetch_to_device(
+                    ds.chunked_epoch(self.batch_size,
+                                     [self.features_col, self.label_col],
+                                     window=1, chunk_windows=self.chunk_windows),
+                    place)
+                for chunk_idx, (xs, ys) in enumerate(placed):
                     if needs_rng:
                         keys = self._batch_keys(epoch, chunk_idx, (xs.shape[0],))
                         params, opt_state, losses = epoch_fn(
-                            params, opt_state, jnp.asarray(xs), jnp.asarray(ys),
-                            jnp.asarray(keys))
+                            params, opt_state, xs, ys, jnp.asarray(keys))
                     else:
                         params, opt_state, losses = epoch_fn(params, opt_state,
-                                                             jnp.asarray(xs), jnp.asarray(ys))
+                                                             xs, ys)
                     self.history.extend(np.asarray(losses).tolist())
                     samples += xs.shape[0] * xs.shape[1]
-                    chunk_idx += 1
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch, chips=1)
                 val = self._validate(params, validation_data)
                 if val:
@@ -437,21 +442,21 @@ class DistributedTrainer(Trainer):
                 t_epoch = time.time()
                 samples = 0
                 ds = dataset.shuffle(seed=self.seed + epoch) if shuffle else dataset
-                chunk_idx = 0
-                for chunk in ds.chunked_epoch(global_batch,
-                                              [self.features_col, self.label_col],
-                                              window=self.communication_window,
-                                              chunk_windows=self.chunk_windows):
+                placed = prefetch_to_device(
+                    ds.chunked_epoch(global_batch,
+                                     [self.features_col, self.label_col],
+                                     window=self.communication_window,
+                                     chunk_windows=self.chunk_windows),
+                    lambda ch: engine.place_data(ch[self.features_col],
+                                                 ch[self.label_col]))
+                for chunk_idx, (xs_d, ys_d) in enumerate(placed):
                     keys = None
                     if engine.needs_rng:
-                        keys = self._batch_keys(
-                            epoch, chunk_idx, chunk[self.features_col].shape[:2])
-                    state, losses = engine.run_epoch(state, chunk[self.features_col],
-                                                     chunk[self.label_col], keys=keys)
+                        keys = self._batch_keys(epoch, chunk_idx, xs_d.shape[:2])
+                    state, losses = engine.run_epoch(state, xs_d, ys_d, keys=keys)
                     self.history.extend(losses.tolist())
-                    samples += (chunk[self.features_col].shape[0]
+                    samples += (xs_d.shape[0]
                                 * self.communication_window * global_batch)
-                    chunk_idx += 1
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
                                            chips=self.num_workers)
                 if validation_data is not None:
