@@ -612,6 +612,50 @@ def _bench_decode(*, batch: int = 8, prompt_len: int = 128, new_tokens: int = 51
     out["speculative_speedup_vs_fp_batched"] = round(
         out["speculative_batched"]["tokens_per_sec"]
         / out["fp_trained"]["tokens_per_sec"], 3)
+
+    # k=12 promoted from round-4 prose (82.0k tok/s then): a longer draft
+    # window commits more tokens per target pass while trained-pair
+    # acceptance stays high; recorded + tripwired like every other leg
+    k12 = 12
+    sfn12 = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=k12,
+                                         with_stats=True)
+    toks, iters12 = sfn12(t_params, d_params, prompt)
+    np.asarray(toks)
+    acc12 = ((new_tokens - 1) / max(int(iters12), 1) - 1.0) / k12
+    out["speculative_k12"] = leg(
+        _device_time_ms(sfn12, t_params, d_params, prompt, reps=reps),
+        n=batch * new_tokens, draft_layers=2, draft_dim=draft_dim, k=k12,
+        draft_step=resolve_step_impl(
+            draft_spec.config, batch, prompt_len + new_tokens + k12 + 1, None),
+        acceptance_rate=round(float(min(max(acc12, 0.0), 1.0)), 3),
+        trained=True)
+
+    # b64 lockstep speculative, bf16 vs int8 KV caches: at this batch the
+    # per-row KV reads are the dominant decode cost (the plain fp_b64 ->
+    # kv_int8_b64 pair measured 1.91x), so halving cache traffic should
+    # compound with the draft's sequential-step savings — measured, not
+    # assumed, incl. the lockstep acceptance decay at 64 rows
+    toks, iters64 = sfn(t_params, d_params, prompt_big)
+    np.asarray(toks)
+    acc64 = ((new_tokens - 1) / max(int(iters64), 1) - 1.0) / k
+    out["speculative_b64"] = leg(
+        _device_time_ms(sfn, t_params, d_params, prompt_big, reps=reps),
+        n=big * new_tokens, draft_layers=2, draft_dim=draft_dim, k=k,
+        draft_step=resolve_step_impl(
+            draft_spec.config, big, prompt_len + new_tokens + k + 1, None),
+        acceptance_rate=round(float(min(max(acc64, 0.0), 1.0)), 3),
+        trained=True)
+    qsfn = make_speculative_generate_fn(spec, draft_spec, new_tokens, k=k,
+                                        with_stats=True, quantize_cache=True)
+    toks, qiters64 = qsfn(t_params, d_params, prompt_big)
+    np.asarray(toks)
+    qacc64 = ((new_tokens - 1) / max(int(qiters64), 1) - 1.0) / k
+    out["speculative_kv_int8_b64"] = leg(
+        _device_time_ms(qsfn, t_params, d_params, prompt_big, reps=reps),
+        n=big * new_tokens, draft_layers=2, draft_dim=draft_dim, k=k,
+        kv_cache="int8",
+        acceptance_rate=round(float(min(max(qacc64, 0.0), 1.0)), 3),
+        trained=True)
     # one wall fallback anywhere taints the whole section's tag: a wall
     # number under a device-keyed baseline is the false-tripwire class
     # this methodology change exists to kill
@@ -1047,12 +1091,14 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     # with it, and lockstep acceptance shrinks as agreement^batch) carry
     # the batch in their key; the *_b1 modes always run batch 1 and must
     # NOT be invalidated by a section-batch change
-    batched_modes = {"fp", "int8", "fp_trained", "speculative_batched"}
-    # fp_b64 / kv_int8_b64 run a FIXED batch 64 (the mode name carries
-    # it), independent of the section batch
+    batched_modes = {"fp", "int8", "fp_trained", "speculative_batched",
+                     "speculative_k12"}
+    # fp_b64 / kv_int8_b64 / speculative_*b64 run a FIXED batch 64 (the
+    # mode name carries it), independent of the section batch
     for mode in ("fp", "int8", "fp_b1", "fp_b1_trained", "fp_trained",
-                 "speculative_b1", "speculative_batched", "fp_b64",
-                 "kv_int8_b64"):
+                 "speculative_b1", "speculative_batched", "speculative_k12",
+                 "fp_b64", "kv_int8_b64", "speculative_b64",
+                 "speculative_kv_int8_b64"):
         sub = dec.get(mode)
         # methodology-coded key: generation length and timing stat are part
         # of the identity, so the round-3 min-of-2-wall/256-token records

@@ -40,27 +40,50 @@ def prefetch_to_device(chunks: Iterator, place: Callable,
         import threading
 
         q: "queue.Queue" = queue.Queue(maxsize=1)
-        done = object()
+        stop = threading.Event()
+        # the producer must NOT capture the `chunks` cell: it is rebound to
+        # the produced() generator below, and a closure reference from the
+        # live thread would keep that generator (and so its stop-setting
+        # finalizer) alive exactly until stop is set — a reference deadlock
+        # that leaked the thread on abandoned consumers
+        source = chunks
+
+        def put(item) -> bool:
+            # bounded-wait put so an abandoned consumer (exception mid-
+            # epoch, early break) cannot strand this thread in q.put
+            # forever — it notices `stop` within 0.1s, drops its chunk,
+            # and exits instead of leaking a thread + a chunk per retry
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
-                for c in chunks:
-                    q.put(("chunk", c))
+                for c in source:
+                    if not put(("chunk", c)):
+                        return
             except BaseException as exc:  # surfaced on the consumer side
-                q.put(("error", exc))
+                put(("error", exc))
             else:
-                q.put(("done", done))
+                put(("done", None))
 
         threading.Thread(target=producer, daemon=True).start()
 
         def produced():
-            while True:
-                kind, val = q.get()
-                if kind == "error":
-                    raise val
-                if kind == "done":
-                    return
-                yield val
+            try:
+                while True:
+                    kind, val = q.get()
+                    if kind == "error":
+                        raise val
+                    if kind == "done":
+                        return
+                    yield val
+            finally:
+                stop.set()  # runs on normal exhaustion AND GeneratorExit
 
         chunks = produced()
     it = iter(chunks)

@@ -112,7 +112,8 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
                                  temperature: float = 0.0,
                                  eos_id: Optional[int] = None, pad_id: int = 0,
                                  with_stats: bool = False,
-                                 draft_step_impl: Optional[str] = None):
+                                 draft_step_impl: Optional[str] = None,
+                                 quantize_cache: bool = False):
     """Build a jitted ``(target_params, draft_params, prompt [B, P]) ->
     tokens [B, max_new_tokens]`` — greedy; bit-identical to
     ``make_generate_fn(target_spec, ...)`` in float32 (see module docstring
@@ -148,6 +149,16 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     ``"fused"``/``"xla"`` pin the path.  The target's k+1-token verify
     window is MXU-shaped and always stays XLA.
 
+    ``quantize_cache=True`` stores BOTH models' KV int8 with per-(position,
+    head) scales (:class:`~distkeras_tpu.models.decode.QKVCache`), exactly
+    like ``make_generate_fn``'s flag: cache HBM traffic halves — the
+    dominant batched-decode cost, 1.91x on the plain b64 leg — at one
+    rounding step per K/V row.  Rewound draft rows re-quantize on
+    overwrite (per-position state, so the rewind semantics are
+    unchanged).  Requires the XLA draft step (the fused kernel's slabs
+    are bf16), so it suits the BATCHED regime where the fused draft
+    would not be auto-selected anyway.
+
     ``with_stats=True`` returns ``(tokens, iterations)`` where
     ``iterations`` is the number of draft/verify rounds the while-loop ran.
     Without EOS the loop commits ``max_new_tokens - 1`` tokens (the first
@@ -174,6 +185,10 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
     if draft_step_impl not in (None, "fused", "xla"):
         raise ValueError(f"unknown draft_step_impl {draft_step_impl!r}; "
                          "use None, 'fused' or 'xla'")
+    if quantize_cache and draft_step_impl == "fused":
+        raise ValueError("quantize_cache requires the XLA draft step: the "
+                         "fused kernel's slabs are bf16 (draft_step_impl="
+                         "'xla' or None)")
 
     sampling = temperature > 0.0
 
@@ -194,8 +209,8 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
             from distkeras_tpu.ops.decode_step import round_cache_len
 
             d_total = round_cache_len(total)  # dead rows stay masked
-        t_cache = init_cache(t_cfg, b, total)
-        d_cache = init_cache(d_cfg, b, d_total)
+        t_cache = init_cache(t_cfg, b, total, quantized=quantize_cache)
+        d_cache = init_cache(d_cfg, b, d_total, quantized=quantize_cache)
 
         t_logits, t_cache = forward_with_cache(t_params, t_cfg, prompt, 0,
                                                t_cache, last_only=True)
@@ -358,9 +373,13 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         prompt = jnp.asarray(prompt)
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        impl = resolve_step_impl(
-            d_cfg, prompt.shape[0], prompt.shape[1] + max_new_tokens + k + 1,
-            draft_step_impl, what="draft_step_impl")
+        if quantize_cache:
+            impl = "xla"  # QKVCache slabs are int8; the fused kernel's bf16
+        else:
+            impl = resolve_step_impl(
+                d_cfg, prompt.shape[0],
+                prompt.shape[1] + max_new_tokens + k + 1,
+                draft_step_impl, what="draft_step_impl")
         return run(t_params, d_params, prompt, rng, prompt.shape[1], impl)
 
     return generate_fn

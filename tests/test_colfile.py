@@ -203,6 +203,34 @@ def test_prefetch_to_device_order_and_lookahead():
     assert list(prefetch_to_device(iter(()), place)) == []
 
 
+def test_prefetch_producer_exits_on_abandoned_consumer():
+    """An exception (or early break) mid-epoch abandons the prefetch
+    generator; the background producer must notice and exit instead of
+    blocking in q.put forever (a leaked thread + chunk per retry)."""
+    import gc
+    import threading
+    import time
+
+    from distkeras_tpu.data.dataset import prefetch_to_device
+
+    base = threading.active_count()
+
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    it = prefetch_to_device(endless(), lambda c: c)
+    assert next(it) == 0
+    it.close()  # consumer abandons mid-epoch
+    deadline = time.time() + 5
+    while threading.active_count() > base and time.time() < deadline:
+        gc.collect()  # the inner generator's finally runs on collection
+        time.sleep(0.05)
+    assert threading.active_count() <= base, "producer thread leaked"
+
+
 def test_out_of_core_epoch_bounded_anonymous_memory(tmp_path):
     """Train through a ColumnFile LARGER than the bounded feed chunks and
     assert the process's ANONYMOUS memory (heap + device buffers on the
@@ -237,17 +265,15 @@ def test_out_of_core_epoch_bounded_anonymous_memory(tmp_path):
     # not be attributed to the feed path (the test would otherwise be
     # order-dependent — failing when run alone, passing after earlier
     # tests warm the runtime)
-    from distkeras_tpu.data.dataset import Dataset as _DS
-    from distkeras_tpu.models.base import ModelSpec as _MS
-    from distkeras_tpu.trainers import SingleTrainer as _ST
+    from distkeras_tpu.data.dataset import Dataset
 
     warm_rng = np.random.default_rng(1)
-    warm_ds = _DS({"features": warm_rng.normal(size=(512, feat)).astype(np.float32),
-                   "label": np.eye(4, dtype=np.float32)[warm_rng.integers(0, 4, 512)]})
-    _ST(_MS(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 4},
-            input_shape=(feat,)),
-        batch_size=64, num_epoch=1, learning_rate=0.1,
-        chunk_windows=8).train(warm_ds, shuffle=True)
+    warm_ds = Dataset({"features": warm_rng.normal(size=(512, feat)).astype(np.float32),
+                       "label": np.eye(4, dtype=np.float32)[warm_rng.integers(0, 4, 512)]})
+    SingleTrainer(ModelSpec(name="mlp", config={"hidden_sizes": (8,), "num_outputs": 4},
+                            input_shape=(feat,)),
+                  batch_size=64, num_epoch=1, learning_rate=0.1,
+                  chunk_windows=8).train(warm_ds, shuffle=True)
     del warm_ds
     gc.collect()
     base_kb = rss_anon_kb()

@@ -60,6 +60,30 @@ def test_quantized_draft_still_exact(target):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_quantized_kv_cache_matches_plain_quantized_decode(target):
+    """quantize_cache speculative == plain decode with the SAME int8
+    cache rounding: both attend over identically-quantized K/V rows, so
+    the committed-token contract holds verbatim (the draft changes the
+    schedule, never the math).  Also covers the rewound-row re-quantize
+    path (uncommitted draft rows overwritten next round)."""
+    from distkeras_tpu.models.decode import make_generate_fn
+
+    draft = Model.init(_spec(layers=1, dim=32), seed=99)
+    prompt = jnp.asarray([[40, 2, 21], [7, 7, 1]], jnp.int32)
+    want = make_generate_fn(target.spec, 10, quantize_cache=True)(
+        target.params, prompt)
+    fn = make_speculative_generate_fn(target.spec, draft.spec, 10, k=3,
+                                      quantize_cache=True)
+    got = fn(target.params, draft.params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # the fused draft step cannot serve an int8 cache: loud refusal
+    import pytest
+    with pytest.raises(ValueError, match="quantize_cache"):
+        make_speculative_generate_fn(target.spec, draft.spec, 10, k=3,
+                                     quantize_cache=True,
+                                     draft_step_impl="fused")
+
+
 def test_batched_matches_per_row_greedy(target):
     """Batched lockstep commit: every row of a batch-3 speculative decode
     equals that row's own plain greedy decode, for a good AND a bad
