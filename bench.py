@@ -74,9 +74,19 @@ def _init_backend(retries: int = 3, wait_s: float = 10.0):
     return jax.default_backend(), f"{type(last).__name__}: {last}"
 
 
-# v5e sweet spot from the 2026-07-30 in-program sweep (see _bench_mnist_cnn);
-# the single source for both the bench config and the reported metadata
+# v5e sweet spot from the 2026-07-30 in-program sweep (see _bench_mnist_cnn),
+# re-confirmed under bf16 (2026-07-31: 1024 -> 1.543M, 2048 -> 1.523M,
+# 4096 -> 1.037M); the single source for both the bench config and the
+# reported metadata
 _MNIST_BATCH = 1024
+# round-5 headline config: the compute_dtype="bfloat16" policy (bf16
+# activations over f32 params, f32 logits — models/cnn.py) measured
+# 1.35x the f32 headline (1.543M vs 1.140M samples/s/chip, device time).
+# NOTE the history: round 2 measured "bf16 slower" and kept f32 — that
+# experiment cast the whole model; the activations-only policy keeps the
+# optimizer/params f32 and lets XLA fuse the casts into the convs.  The
+# f32 number stays recorded next to the headline (mnist_cnn_f32).
+_MNIST_DTYPE = "bfloat16"
 
 # bump whenever the headline measurement itself changes (batch size, dispatch
 # structure, timing source, ...); vs_baseline is only computed against a
@@ -87,12 +97,15 @@ _MNIST_BATCH = 1024
 # ~0.01%.  Falls back to the v2 wall tag when the trace has no module
 # events (CPU runs), so a wall number can never ratio against the
 # device-keyed baseline.
-_METHODOLOGY = "in-program-multi-epoch-v3-device"
+# v4: the headline CONFIG changed (bf16 compute_dtype policy, round 5) —
+# per the rule above, the tag bumps so a v3-f32 record can never produce a
+# bogus cross-config ratio in either direction
+_METHODOLOGY = "in-program-multi-epoch-v4-device-bf16"
 _METHODOLOGY_WALL = "in-program-multi-epoch-v2"
 
 
 def _bench_mnist_cnn(batch_size: int = _MNIST_BATCH, num_batches: int = 200, reps: int = 3,
-                     repeat: int = 3):
+                     repeat: int = 3, compute_dtype=None):
     """Headline number: MNIST-CNN scan-epoch training throughput.
     Returns (samples_per_sec_per_chip, methodology_tag).
 
@@ -102,9 +115,12 @@ def _bench_mnist_cnn(batch_size: int = _MNIST_BATCH, num_batches: int = 200, rep
     epoch, host sync between) measured that latency, not the chip — moving
     the loop in-program took the same model from ~400k to ~1M samples/sec.
     batch 1024 is the measured v5e sweet spot (sweep 2026-07-30, in-program:
-    512->765k, 1024->999k, 2048->565k, 4096->520k samples/sec; bf16 compute
-    measured SLOWER than f32 here — the convs are too small to feed the
-    MXU, so the layout conversions dominate).  Timed on DEVICE time
+    512->765k, 1024->999k, 2048->565k, 4096->520k samples/sec; re-held
+    under bf16 in round 5).  ``compute_dtype`` selects the model's
+    mixed-precision policy: "bfloat16" (the round-5 headline) measured
+    1.35x f32 — the round-2 "bf16 slower" finding applied to a
+    whole-model cast, not the activations-only policy.  Timed on DEVICE
+    time
     (median of ``repeat`` in-trace runs; see ``_device_time_ms``), wall
     fallback off-TPU."""
     import jax
@@ -118,7 +134,7 @@ def _bench_mnist_cnn(batch_size: int = _MNIST_BATCH, num_batches: int = 200, rep
     from distkeras_tpu.ops.losses import get_loss
     from distkeras_tpu.parallel.engine import make_minibatch_step
 
-    spec = mnist_cnn_spec()
+    spec = mnist_cnn_spec(compute_dtype=compute_dtype)
     model = Model.init(spec, seed=0)
     optimizer = optax.sgd(0.01, momentum=0.9)
     mini = make_minibatch_step(spec.apply_fn(), get_loss("categorical_crossentropy"), optimizer)
@@ -1125,10 +1141,24 @@ def main() -> None:
         if init_error:
             out["init_error"] = init_error
 
-        sps_per_chip, method = _bench_mnist_cnn()
+        sps_per_chip, method = _bench_mnist_cnn(compute_dtype=_MNIST_DTYPE)
         out["value"] = round(sps_per_chip, 1)
         out["batch_size"] = _MNIST_BATCH
+        out["compute_dtype"] = _MNIST_DTYPE
         out["methodology"] = method
+        try:
+            # A/B: the same headline model in plain float32 — the
+            # pre-round-5 headline config — recorded next to the bf16
+            # headline so the compute_dtype policy's win at this scale
+            # stays a recorded number, not folklore (see _MNIST_DTYPE)
+            f32_sps, f32_method = _bench_mnist_cnn()
+            out["mnist_cnn_f32"] = {
+                "samples_per_sec_per_chip": round(f32_sps, 1),
+                "headline_vs_f32": round(sps_per_chip / f32_sps, 4),
+                "methodology": f32_method,
+            }
+        except Exception as e:
+            out["mnist_cnn_f32"] = {"error": f"{type(e).__name__}: {e}"}
 
         baseline_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
