@@ -10,7 +10,9 @@ of re-running the full sequence (O(L) per token instead of O(L²)).
 Implementation notes:
 
 - Pure functions over the published param tree (``embed``, ``pos_embed``,
-  ``block_{i}.{LayerNorm_0,qkv,proj,LayerNorm_1,up,down}``, ``final_norm``)
+  ``block_{i}.{LayerNorm_0,qkv,proj,LayerNorm_1,up,down}``, ``final_norm``;
+  GQA specs replace the fused ``qkv`` leaf with ``q`` [E, H, Dh] and
+  ``kv`` [E, 2, Hkv, Dh] — ``_block`` dispatches on which is present)
   rather than a Flax method: a compact Flax module allows only one
   ``nn.compact`` method, and threading a mutable cache collection through
   ``module.apply`` would force the training path to carry decode-only
@@ -25,9 +27,10 @@ Implementation notes:
   single-token steps over a fixed ``max_new_tokens``; finished rows (past
   EOS) keep emitting ``pad_id`` under a carried ``done`` flag instead of
   breaking out, which is the compiler-friendly form of early exit.
-- The KV cache is [num_layers, B, cache_len, H, Dh] in the compute dtype
-  (bfloat16 by default) — the decode-time HBM working set — and attention
-  logits/softmax run in float32 like the training path.
+- The KV cache is [num_layers, B, cache_len, Hkv, Dh] in the compute dtype
+  (bfloat16 by default; Hkv = ``num_kv_heads`` under GQA, else H) — the
+  decode-time HBM working set — and attention logits/softmax run in
+  float32 like the training path.
 """
 
 from __future__ import annotations
@@ -52,9 +55,10 @@ def _wmul(eq: str, y: jnp.ndarray, w, dtype) -> jnp.ndarray:
     int8 — the convert fuses into the matmul's operand read and the scale
     multiply into its epilogue, keeping per-step HBM weight traffic at 1
     byte/elem instead of materializing an f32 copy outside the decode loop.
-    Every block kernel here (qkv [E,3,H,Dh], proj [H,Dh,E], up [E,F],
-    down [F,E]) has its channel axis last and uncontracted; the embedding
-    does NOT (``attend`` contracts E), so it is dequantized once up front.
+    Every block kernel here (qkv [E,3,H,Dh] — or the GQA pair q [E,H,Dh] /
+    kv [E,2,Hkv,Dh] — proj [H,Dh,E], up [E,F], down [F,E]) has its channel
+    axis last and uncontracted; the embedding does NOT (``attend``
+    contracts E), so it is dequantized once up front.
     """
     if isinstance(w, QTensor):
         out = jnp.einsum(eq, y, w.q.astype(dtype))
@@ -163,8 +167,15 @@ def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
     quant = isinstance(cache, QKVCache)
 
     y = _layer_norm(pb["LayerNorm_0"], x, dtype)
-    qkv = _wmul("ble,eshd->blshd", y, pb["qkv"]["kernel"], dtype)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if "qkv" in pb:
+        qkv = _wmul("ble,eshd->blshd", y, pb["qkv"]["kernel"], dtype)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        # GQA layout: separate q [E, H, Dh] and kv [E, 2, Hkv, Dh]
+        # projections (models/transformer.py); the cache stores Hkv heads
+        q = _wmul("ble,ehd->blhd", y, pb["q"]["kernel"], dtype)
+        kv = _wmul("ble,eshd->blshd", y, pb["kv"]["kernel"], dtype)
+        k, v = kv[:, :, 0], kv[:, :, 1]
     if quant:
         k_rows, k_rows_scale = _quantize_rows(k)
         v_rows, v_rows_scale = _quantize_rows(v)
@@ -176,7 +187,16 @@ def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
         cache.v, v_rows[None], (layer, 0, start_pos, 0, 0))
     ck, cv = k_all[layer], v_all[layer]
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(dtype) if quant else ck,
+    # grouped heads: fold the query heads as [Hkv, G] and contract each
+    # group against its single cached KV head — the cache slabs feed the
+    # einsums at Hkv width, never materializing an H-headed copy (that
+    # read traffic is GQA's savings); G == 1 reduces to plain MHA
+    b, l, hq, _ = q.shape
+    hkv = ck.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, l, hkv, g, head_dim)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                        ck.astype(dtype) if quant else ck,
                         preferred_element_type=jnp.float32)
     scores = scores * (1.0 / head_dim ** 0.5)
     if quant:
@@ -184,16 +204,17 @@ def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
             cache.k_scale, k_rows_scale[None], (layer, 0, start_pos, 0, 0))
         v_scale = lax.dynamic_update_slice(
             cache.v_scale, v_rows_scale[None], (layer, 0, start_pos, 0, 0))
-        # [L?, B, S, H, 1] -> [B, H, 1, S] broadcast along the key axis
-        scores = scores * k_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, :]
-    q_pos = start_pos + lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        # [L?, B, S, Hkv, 1] -> [B, Hkv, 1, 1, S] broadcast along keys
+        scores = scores * k_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+    q_pos = start_pos + lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
     scores = jnp.where(k_pos <= q_pos, scores, float("-inf"))
     attn = jax.nn.softmax(scores, axis=-1)
     if quant:
-        attn = attn * v_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, :]
+        attn = attn * v_scale[layer][..., 0].transpose(0, 2, 1)[:, :, None, None, :]
     attn = attn.astype(dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", attn, cv.astype(dtype) if quant else cv)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", attn,
+                   cv.astype(dtype) if quant else cv).reshape(b, l, hq, head_dim)
     o = _wmul("bqhd,hde->bqe", o, pb["proj"]["kernel"], dtype)
     x = x + o
 
@@ -208,10 +229,13 @@ def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
 def init_cache(config: dict, batch: int, cache_len: int,
                quantized: bool = False):
     """Zero cache sized for ``cache_len`` total positions (prompt + new);
-    ``quantized`` selects the int8 :class:`QKVCache` layout."""
+    ``quantized`` selects the int8 :class:`QKVCache` layout.  Under GQA
+    the cache holds only ``num_kv_heads`` heads — the bytes (and decode
+    HBM traffic) shrink by num_kv_heads/num_heads, which is the feature's
+    whole point at serving batch sizes."""
     n_layers = config["num_layers"]
-    heads = config["num_heads"]
-    head_dim = config["model_dim"] // heads
+    heads = config.get("num_kv_heads") or config["num_heads"]
+    head_dim = config["model_dim"] // config["num_heads"]
     shape = (n_layers, batch, cache_len, heads, head_dim)
     if quantized:
         sshape = shape[:-1] + (1,)
@@ -508,6 +532,11 @@ def make_sharded_generate_fn(spec: ModelSpec, mesh, max_new_tokens: int, *,
     if spec.config["num_heads"] % tp:
         raise ValueError(f"num_heads {spec.config['num_heads']} not divisible "
                          f"by tp={tp} over mesh axis {tp_axis!r}")
+    kv_heads = spec.config.get("num_kv_heads") or spec.config["num_heads"]
+    if kv_heads % tp:
+        raise ValueError(f"num_kv_heads {kv_heads} not divisible by tp={tp}: "
+                         "the cache's head axis is the sharded one — use a "
+                         "tp that divides the KV heads, or dp-only decoding")
 
     def fn(params, prompt, rng=None):
         if any(isinstance(l, QTensor) for l in jax.tree.leaves(

@@ -43,6 +43,15 @@ def _maybe_psum(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
 class TransformerBlock(nn.Module):
     model_dim: int
     num_heads: int            # GLOBAL head count; local = num_heads // tp_size
+    num_kv_heads: Optional[int] = None  # grouped-query attention (GQA,
+                              # Ainslie et al. 2023): K/V projected to this
+                              # many heads, each shared by num_heads/
+                              # num_kv_heads query heads.  None = MHA (the
+                              # fused qkv projection and its param layout
+                              # are preserved exactly); set => separate
+                              # "q" and "kv" projections.  The win is the
+                              # decode KV cache (num_kv_heads/num_heads
+                              # the bytes) and the ring's ICI traffic
     mlp_ratio: int = 4
     seq_axis: Optional[str] = None  # mesh axis name for ring attention
     tp_axis: Optional[str] = None   # mesh axis name for tensor parallelism
@@ -70,11 +79,26 @@ class TransformerBlock(nn.Module):
         heads_local = self.num_heads // self.tp_size
         head_dim = self.model_dim // self.num_heads
         ffn_local = self.mlp_ratio * self.model_dim // self.tp_size
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(f"num_heads {self.num_heads} not a multiple of "
+                             f"num_kv_heads {kv_heads}")
 
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        qkv = nn.DenseGeneral((3, heads_local, head_dim), use_bias=False,
-                              dtype=self.compute_dtype, name="qkv")(y)  # [B, L, 3, Hl, Dh]
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_heads == self.num_heads:
+            qkv = nn.DenseGeneral((3, heads_local, head_dim), use_bias=False,
+                                  dtype=self.compute_dtype, name="qkv")(y)  # [B, L, 3, Hl, Dh]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            if kv_heads % self.tp_size:
+                raise ValueError(f"num_kv_heads {kv_heads} not divisible by "
+                                 f"tp_size {self.tp_size}")
+            q = nn.DenseGeneral((heads_local, head_dim), use_bias=False,
+                                dtype=self.compute_dtype, name="q")(y)
+            kv = nn.DenseGeneral((2, kv_heads // self.tp_size, head_dim),
+                                 use_bias=False, dtype=self.compute_dtype,
+                                 name="kv")(y)
+            k, v = kv[:, :, 0], kv[:, :, 1]
         o = attention(q, k, v, causal=True, axis_name=self.seq_axis, impl=self.attn_impl)
         o = nn.DenseGeneral(self.model_dim, axis=(-2, -1), use_bias=False,
                             dtype=self.compute_dtype, name="proj")(o)  # [B, L, E] partial
@@ -124,6 +148,7 @@ class TransformerLM(nn.Module):
                          # attention matmuls over the MXU's full 128-wide
                          # systolic dim and halves per-score VPU overhead —
                          # 0.577 vs 0.389 MFU at 2k tokens vs head_dim 64)
+    num_kv_heads: Optional[int] = None  # GQA (see TransformerBlock); None = MHA
     num_layers: int = 6
     max_seq_len: int = 2048
     mlp_ratio: int = 4
@@ -157,6 +182,7 @@ class TransformerLM(nn.Module):
             TransformerBlock(
                 model_dim=self.model_dim,
                 num_heads=self.num_heads,
+                num_kv_heads=self.num_kv_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis,
                 tp_axis=self.tp_axis,
@@ -218,6 +244,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
                   tp_axis: Optional[str] = None, remat: bool = False,
                   moe_experts: int = 0, moe_capacity: int = 0,
                   moe_top_k: int = 1,
+                  num_kv_heads: Optional[int] = None,
                   attn_impl: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
@@ -231,6 +258,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "vocab_size": vocab_size,
             "model_dim": model_dim,
             "num_heads": num_heads,
+            "num_kv_heads": num_kv_heads,
             "num_layers": num_layers,
             "max_seq_len": max_seq_len,
             "seq_axis": seq_axis,

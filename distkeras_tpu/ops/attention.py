@@ -21,13 +21,33 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def repeat_kv_heads(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray):
+    """Broadcast grouped KV heads up to the query head count (GQA).
+
+    q [B, Lq, H, D], k/v [B, Lk, Hkv, D] with H a multiple of Hkv: each
+    group of H/Hkv query heads shares one KV head (Ainslie et al. 2023).
+    Identity when the counts already match (MHA).  The repeat happens at
+    the last possible moment — callers that MOVE k/v first (the ring's
+    ppermute rotation, the decode cache's HBM reads) keep the Hkv-sized
+    tensors on the wire/in memory, which is the point of GQA."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq == hkv:
+        return k, v
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True,
                     q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
-    """Plain softmax attention. Shapes: q [B, Lq, H, D], k/v [B, Lk, H, D].
+    """Plain softmax attention. Shapes: q [B, Lq, H, D], k/v [B, Lk, H, D]
+    (or [B, Lk, Hkv, D] with grouped KV heads — broadcast up internally).
 
     ``q_offset``/``k_offset`` are the global positions of the first query /
     key element — needed when the caller holds only a shard of the sequence.
     """
+    k, v = repeat_kv_heads(q, k, v)
     depth = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
     if causal:
@@ -111,12 +131,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, axis_name: st
 
     def block_attn(k_blk, v_blk, step_causal):
         # one (o, lse) partial for the local q block against one kv block;
-        # lse is log-sum-exp of the scaled scores [B, H, Lq].  The flash
+        # lse is log-sum-exp of the scaled scores [B, H, Lq].  Grouped KV
+        # heads (GQA) broadcast up HERE — after the ppermute rotation — so
+        # the ICI ring carries only the Hkv-sized tensors.  The flash
         # kernel always runs causal=True: a live step s > 0 passes
         # q_offset=l_local so every key is provably in the past and the
         # kernel's mask takes its identity branch everywhere (same cost as
         # an unmasked kernel, and it sidesteps a pallas-interpreter vma
         # bug that trips the causal=False kernel under shard_map on CPU)
+        k_blk, v_blk = repeat_kv_heads(q, k_blk, v_blk)
         if use_flash:
             from distkeras_tpu.ops.flash_attention import flash_attention_with_lse
 
@@ -233,6 +256,11 @@ def attention(q, k, v, causal: bool = True, axis_name: Optional[str] = None,
         if impl == "flash":
             from distkeras_tpu.ops.flash_attention import flash_attention
 
+            # the Pallas kernel contracts equal head counts; grouped KV
+            # heads broadcast up here (training holds the full sequence
+            # anyway — GQA's memory win is the decode cache and the ring's
+            # ICI traffic, both handled elsewhere)
+            k, v = repeat_kv_heads(q, k, v)
             return flash_attention(q, k, v, causal=causal)
         if impl != "dense":
             raise ValueError(f"unknown attention impl {impl!r}: expected 'flash' or 'dense'")

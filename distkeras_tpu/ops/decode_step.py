@@ -166,7 +166,9 @@ def fused_step_supported(config: dict, batch: int, cache_len: int) -> bool:
     # batch cap: the kernel's [B*H, B*S] f32 score block grows
     # quadratically with batch (6MB at b16/s768); past 16 rows plain
     # batched decode amortizes fine anyway
+    kv_heads = config.get("num_kv_heads") or h
     return (e % 128 == 0 and f % 128 == 0 and h <= 128
+            and kv_heads == h  # GQA's split q/kv layout: XLA step only (v1)
             and not config.get("moe_experts")
             and cache_len % 128 == 0 and 1 <= batch <= 16
             and _kernel_vmem_bytes(config, batch, cache_len) <= _VMEM_BUDGET)

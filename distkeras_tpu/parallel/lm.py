@@ -54,6 +54,13 @@ def _tp_leaf_spec(path, leaf, tp_axis: Optional[str]) -> P:
     if "kernel" in names:
         if "qkv" in names and ndim == 4:
             return P(None, None, tp_axis, None)
+        # GQA split layout: q [E, H, Dh] and kv [E, 2, Hkv, Dh] are both
+        # column-parallel over their head axis (num_kv_heads % tp_size is
+        # validated by TransformerBlock)
+        if "q" in names and ndim == 3:
+            return P(None, tp_axis, None)
+        if "kv" in names and ndim == 4:
+            return P(None, None, tp_axis, None)
         if "proj" in names and ndim == 3:
             return P(tp_axis, None, None)
         if "up" in names and ndim == 2:
