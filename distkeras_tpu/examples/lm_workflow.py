@@ -44,6 +44,10 @@ def main() -> None:
     parser.add_argument("--model-dim", type=int, default=128)
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--sample-len", type=int, default=24)
+    parser.add_argument("--kv-heads", type=int, default=None,
+                        help="grouped-query attention: KV head count "
+                             "(default: = query heads, i.e. MHA); shrinks "
+                             "the decode cache by the head ratio")
     args = parser.parse_args()
     if args.steps < 1:
         parser.error("--steps must be >= 1")
@@ -78,8 +82,13 @@ def main() -> None:
     num_heads = next(h for h in range(max(1, -(-args.model_dim // 128)),
                                       args.model_dim + 1)
                      if args.model_dim % h == 0 and args.model_dim // h <= 128)
+    if args.kv_heads is not None and (
+            args.kv_heads < 1 or num_heads % args.kv_heads):
+        parser.error(f"--kv-heads {args.kv_heads} must be a positive divisor "
+                     f"of the query head count {num_heads}")
     spec = small_lm_spec(vocab_size=args.vocab, model_dim=args.model_dim,
                          num_heads=num_heads,
+                         num_kv_heads=args.kv_heads,
                          num_layers=args.layers,
                          max_seq_len=max(args.seq_len,
                                          args.seq_len // 2 + args.sample_len + 5))

@@ -106,6 +106,31 @@ def test_quantized_cache_gqa(gqa_model):
     np.testing.assert_array_equal(got, want)
 
 
+def test_beam_and_speculative_match_mha_twin(gqa_model):
+    """The rest of the serving family rides the same cache math: beam
+    search scores and speculative commits equal the MHA twin's."""
+    from distkeras_tpu.models.beam import make_beam_search_fn
+    from distkeras_tpu.models.speculative import make_speculative_generate_fn
+
+    twin = _mha_twin(gqa_model)
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    g_toks, g_scores = make_beam_search_fn(gqa_model.spec, 6, beam_width=3)(
+        gqa_model.params, prompt)
+    t_toks, t_scores = make_beam_search_fn(twin.spec, 6, beam_width=3)(
+        twin.params, prompt)
+    np.testing.assert_array_equal(np.asarray(g_toks), np.asarray(t_toks))
+    np.testing.assert_allclose(np.asarray(g_scores), np.asarray(t_scores),
+                               rtol=1e-5, atol=1e-5)
+    # GQA target with an MHA draft: the committed-token contract holds
+    draft = Model.init(small_lm_spec(vocab_size=VOCAB, model_dim=D,
+                                     num_heads=2, num_layers=1,
+                                     max_seq_len=48), seed=9)
+    sfn = make_speculative_generate_fn(gqa_model.spec, draft.spec, 8, k=3)
+    got = np.asarray(sfn(gqa_model.params, draft.params, prompt))
+    want = np.asarray(generate(gqa_model, prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_gqa_under_sequence_parallelism():
     """Ring attention with grouped KV: the ICI ring carries Hkv-headed
     blocks; output equals the unsharded forward."""
