@@ -81,9 +81,12 @@ def make_beam_search_fn(spec: ModelSpec, max_new_tokens: int, *,
         if prompt_len + n > total:
             raise ValueError(f"cache_len = {total} cannot hold prompt "
                              f"({prompt_len}) + max_new_tokens ({n})")
-        if prompt_len + n > max_seq:
+        # the table bound applies only to learned positions (rope has none)
+        if ((config.get("positional") or "learned") == "learned"
+                and prompt_len + n > max_seq):
             raise ValueError(f"prompt ({prompt_len}) + max_new_tokens ({n}) "
-                             f"exceeds max_seq_len = {max_seq}")
+                             f"exceeds the positional table max_seq_len = "
+                             f"{max_seq}")
         params = dequant_embed(params)
         cache = init_cache(config, b, total)
         logits, cache = forward_with_cache(params, config, prompt, 0, cache,

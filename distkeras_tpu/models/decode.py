@@ -145,7 +145,8 @@ def _layer_norm(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
     return (y * p["scale"] + p["bias"]).astype(dtype)
 
 
-def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
+def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype,
+           positional: str = "learned"):
     """One transformer block over ``x`` [B, L, E] with KV caching.
 
     ``cache`` is the STACKED [layers, B, S, H, Dh] :class:`KVCache` (or
@@ -176,6 +177,13 @@ def _block(pb: dict, x: jnp.ndarray, cache, layer: int, start_pos, dtype):
         q = _wmul("ble,ehd->blhd", y, pb["q"]["kernel"], dtype)
         kv = _wmul("ble,eshd->blshd", y, pb["kv"]["kernel"], dtype)
         k, v = kv[:, :, 0], kv[:, :, 1]
+    if positional == "rope":
+        from distkeras_tpu.ops.rotary import rope_rotate
+
+        # K enters the cache ALREADY rotated (rotation depends only on the
+        # row's own absolute position, so cached rows never need revisiting)
+        rpos = start_pos + jnp.arange(x.shape[1])
+        q, k = rope_rotate(q, rpos), rope_rotate(k, rpos)
     if quant:
         k_rows, k_rows_scale = _quantize_rows(k)
         v_rows, v_rows_scale = _quantize_rows(v)
@@ -260,12 +268,15 @@ def forward_with_cache(params: Any, config: dict, tokens: jnp.ndarray,
     """
     dtype = _cfg_dtype(config)
     n_layers = config["num_layers"]
+    positional = config.get("positional") or "learned"
     x = params["embed"]["embedding"].astype(dtype)[tokens]
-    pos = start_pos + jnp.arange(tokens.shape[1])
-    x = x + params["pos_embed"][pos].astype(dtype)
+    if positional == "learned":
+        pos = start_pos + jnp.arange(tokens.shape[1])
+        x = x + params["pos_embed"][pos].astype(dtype)
 
     for i in range(n_layers):
-        x, cache = _block(params[f"block_{i}"], x, cache, i, start_pos, dtype)
+        x, cache = _block(params[f"block_{i}"], x, cache, i, start_pos, dtype,
+                          positional)
 
     if last_only:
         x = x[:, -1:]
@@ -419,7 +430,11 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
             from distkeras_tpu.ops.decode_step import round_cache_len
 
             total = round_cache_len(total)  # K-slab lane tiling
-        if prompt_len + max_new_tokens > max_seq:
+        # the positional-TABLE bound applies only under "learned": rope has
+        # no table and generates past max_seq_len freely (the cache checks
+        # above are the real capacity bound there)
+        if ((config.get("positional") or "learned") == "learned"
+                and prompt_len + max_new_tokens > max_seq):
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the positional table max_seq_len = {max_seq}")

@@ -198,10 +198,14 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         b = prompt.shape[0]
         total = prompt_len + n + k + 1  # speculative writes may run past n
         for name, cfg in (("target", t_cfg), ("draft", d_cfg)):
-            if total > cfg["max_seq_len"]:
+            # learned positional tables bound the reachable positions; rope
+            # models have no table (cache sizing is the only capacity here)
+            if ((cfg.get("positional") or "learned") == "learned"
+                    and total > cfg["max_seq_len"]):
                 raise ValueError(
                     f"prompt + max_new_tokens + k = {total} exceeds the "
-                    f"{name} max_seq_len = {cfg['max_seq_len']}")
+                    f"{name} positional table max_seq_len = "
+                    f"{cfg['max_seq_len']}")
         t_params = dequant_embed(t_params)
         d_params = dequant_embed(d_params)
         d_total = total

@@ -53,6 +53,10 @@ class TransformerBlock(nn.Module):
                               # decode KV cache (num_kv_heads/num_heads
                               # the bytes) and the ring's ICI traffic
     mlp_ratio: int = 4
+    positional: str = "learned"  # "learned" (table added at embed) | "rope"
+                                 # (q/k rotated here by ABSOLUTE position —
+                                 # pos_offset carries the caller's global
+                                 # offset, e.g. rank * L_local under sp)
     seq_axis: Optional[str] = None  # mesh axis name for ring attention
     tp_axis: Optional[str] = None   # mesh axis name for tensor parallelism
     tp_size: int = 1
@@ -67,9 +71,12 @@ class TransformerBlock(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
         if self.num_heads % self.tp_size:
             raise ValueError(f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}")
+        if self.positional not in ("learned", "rope"):
+            raise ValueError(f"positional must be 'learned' or 'rope', "
+                             f"got {self.positional!r}")
         if self.moe_experts and self.tp_size > 1:
             raise ValueError("MoE FFN does not compose with tensor parallelism (v1); "
                              "use either moe_experts or tp_size")
@@ -99,6 +106,15 @@ class TransformerBlock(nn.Module):
                                  use_bias=False, dtype=self.compute_dtype,
                                  name="kv")(y)
             k, v = kv[:, :, 0], kv[:, :, 1]
+        if self.positional == "rope":
+            from distkeras_tpu.ops.rotary import rope_rotate
+
+            # pos_offset is the caller's GLOBAL offset of this sequence
+            # block: the sp training step passes rank * L_local (the same
+            # offset contract the learned table's slicing uses), decoding
+            # rotates inside its own cache path, and plain training passes 0
+            pos = pos_offset + jnp.arange(x.shape[1])
+            q, k = rope_rotate(q, pos), rope_rotate(k, pos)
         o = attention(q, k, v, causal=True, axis_name=self.seq_axis, impl=self.attn_impl)
         o = nn.DenseGeneral(self.model_dim, axis=(-2, -1), use_bias=False,
                             dtype=self.compute_dtype, name="proj")(o)  # [B, L, E] partial
@@ -150,8 +166,10 @@ class TransformerLM(nn.Module):
                          # 0.577 vs 0.389 MFU at 2k tokens vs head_dim 64)
     num_kv_heads: Optional[int] = None  # GQA (see TransformerBlock); None = MHA
     num_layers: int = 6
-    max_seq_len: int = 2048
+    max_seq_len: int = 2048  # positional-table size under "learned"; under
+                             # "rope" only the decode cache-sizing bound
     mlp_ratio: int = 4
+    positional: str = "learned"  # "learned" | "rope" (see TransformerBlock)
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
@@ -176,8 +194,9 @@ class TransformerLM(nn.Module):
         # the compact-era auto-name "LayerNorm_0" — an intentional
         # serialized-format break (no published checkpoints predate it).
         self.embed = nn.Embed(self.vocab_size, self.model_dim, dtype=self.compute_dtype)
-        self.pos_embed = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (self.max_seq_len, self.model_dim))
+        if self.positional == "learned":
+            self.pos_embed = self.param(
+                "pos_embed", nn.initializers.normal(0.02), (self.max_seq_len, self.model_dim))
         self.block = [
             TransformerBlock(
                 model_dim=self.model_dim,
@@ -193,6 +212,7 @@ class TransformerLM(nn.Module):
                 moe_top_k=self.moe_top_k,
                 ep_axis=self.ep_axis,
                 ep_size=self.ep_size,
+                positional=self.positional,
                 compute_dtype=self.compute_dtype,
             )
             for _ in range(self.num_layers)
@@ -200,13 +220,17 @@ class TransformerLM(nn.Module):
         self.final_norm = nn.LayerNorm(dtype=self.compute_dtype)
 
     def embed_tokens(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
-        """Token + positional embedding: [B, L] int32 -> [B, L, E].
+        """Token (+ learned positional) embedding: [B, L] int32 -> [B, L, E].
 
         A real bound method (not a free function passed to
         ``apply(method=...)``) so the pipeline-parallel step can run the
         embedding alone against the same param leaves as ``__call__``.
+        Under ``positional="rope"`` there is no table — position enters
+        through the per-block q/k rotation instead.
         """
         x = self.embed(tokens)
+        if self.positional != "learned":
+            return x
         pos = jnp.arange(tokens.shape[1]) + pos_offset
         return x + self.pos_embed[pos].astype(self.compute_dtype)
 
@@ -218,10 +242,12 @@ class TransformerLM(nn.Module):
     def _trunk(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
         """Embedding + blocks, BEFORE the final norm: [B, L] -> [B, L, E]."""
         x = self.embed_tokens(tokens, pos_offset)
-        run = (nn.remat(lambda m, y: m(y), prevent_cse=False)
-               if self.remat else (lambda m, y: m(y)))
+        # pos_offset rides as a DYNAMIC remat arg: under sequence
+        # parallelism it is a traced axis_index expression, not a constant
+        run = (nn.remat(lambda m, y, po: m(y, po), prevent_cse=False)
+               if self.remat else (lambda m, y, po: m(y, po)))
         for blk in self.block:
-            x = run(blk, x)
+            x = run(blk, x, pos_offset)
         return x
 
     def hidden(self, tokens: jnp.ndarray, pos_offset: int = 0) -> jnp.ndarray:
@@ -245,6 +271,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
                   moe_experts: int = 0, moe_capacity: int = 0,
                   moe_top_k: int = 1,
                   num_kv_heads: Optional[int] = None,
+                  positional: str = "learned",
                   attn_impl: Optional[str] = None):
     from distkeras_tpu.models.base import ModelSpec
 
@@ -259,6 +286,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "model_dim": model_dim,
             "num_heads": num_heads,
             "num_kv_heads": num_kv_heads,
+            "positional": positional,
             "num_layers": num_layers,
             "max_seq_len": max_seq_len,
             "seq_axis": seq_axis,

@@ -169,6 +169,9 @@ def fused_step_supported(config: dict, batch: int, cache_len: int) -> bool:
     kv_heads = config.get("num_kv_heads") or h
     return (e % 128 == 0 and f % 128 == 0 and h <= 128
             and kv_heads == h  # GQA's split q/kv layout: XLA step only (v1)
+            # rope rotates q/k per step; the kernel bakes learned-table
+            # embedding math only (v1) — auto falls back to the XLA step
+            and (config.get("positional") or "learned") == "learned"
             and not config.get("moe_experts")
             and cache_len % 128 == 0 and 1 <= batch <= 16
             and _kernel_vmem_bytes(config, batch, cache_len) <= _VMEM_BUDGET)

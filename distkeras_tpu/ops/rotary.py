@@ -1,0 +1,44 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021).
+
+No reference counterpart (the reference predates transformers) — the
+modern positional default for the flagship LM, next to the learned table:
+instead of adding a position vector to the residual stream, each
+query/key head vector is ROTATED by an angle proportional to its absolute
+position, so the attention score <R(p_q)q, R(p_k)k> depends only on the
+relative offset p_q - p_k.  TPU-friendly by construction: pure elementwise
+cos/sin math that XLA fuses into the projection epilogues, no table in
+HBM, and nothing length-bound — the same weights serve any sequence
+length (``max_seq_len`` remains only a cache-sizing bound for decoding).
+
+Convention: NeoX split-half — the head dim splits into two halves that
+rotate as (x1, x2) -> (x1 cos - x2 sin, x2 cos + x1 sin), with
+frequencies base^(-2i/D).  Rotation runs in float32 (angle precision at
+large positions) and casts back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_rotate(x: jnp.ndarray, positions: jnp.ndarray,
+                base: float = 10000.0) -> jnp.ndarray:
+    """Rotate ``x`` [B, L, H, D] by absolute ``positions`` [L].
+
+    Works for any head count (queries and grouped GQA keys alike) and any
+    even D.  Position 0 is the identity rotation, so un-offset prefixes
+    are unchanged and cached K rows (stored rotated) stay valid forever —
+    rotation depends only on the row's own absolute position.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {d}")
+    half = d // 2
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freq[None, :]   # [L, half]
+    cos = jnp.cos(ang)[None, :, None, :]                           # [1, L, 1, half]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)

@@ -115,7 +115,9 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
     cdtype = cfg.get("compute_dtype", jnp.bfloat16)
     block = TransformerBlock(
         model_dim=cfg["model_dim"], num_heads=cfg["num_heads"],
+        num_kv_heads=cfg.get("num_kv_heads"),
         mlp_ratio=cfg.get("mlp_ratio", 4), seq_axis=None,
+        positional=cfg.get("positional") or "learned",
         attn_impl=cfg.get("attn_impl"), compute_dtype=cdtype)
     module = build_module(spec.name, dict(cfg, seq_axis=None))
 
