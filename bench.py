@@ -885,6 +885,77 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
     }
 
 
+def _bench_pipeline(*, pp: int = 2, num_microbatches: int = 8, batch: int = 8,
+                    seq_len: int = 256, model_dim: int = 256,
+                    num_heads: int = 2, num_layers: int = 4,
+                    vocab: int = 8192, reps: int = 3):
+    """GPipe vs 1F1B step time on a (dp=1, pp) mesh, with the analytic
+    ``head_recompute_factor`` recorded next to the measurement (ADVICE
+    round 5): 1F1B's ``unit_scalar`` runs the final-norm + unembed +
+    vocab-wide softmax-CE on every rank every cycle with the result
+    masked away on all but one rank — roughly ``pp * (1 + 2(pp-1)/M)``
+    times GPipe's unembed FLOPs.  The leg makes the memory-for-FLOPs
+    tradeoff a recorded number instead of a docstring claim (the factor
+    grows with vocab share, so re-run at production vocab before picking
+    a schedule)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.parallel.lm import shift_targets
+    from distkeras_tpu.parallel.mesh import create_nd_mesh
+    from distkeras_tpu.parallel.pipeline import (head_recompute_factor,
+                                                 make_pp_train_step,
+                                                 pp_state_shardings,
+                                                 split_block_params)
+
+    spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
+                         num_heads=num_heads, num_layers=num_layers,
+                         max_seq_len=seq_len)
+    mesh = create_nd_mesh((1, pp), ("dp", "pp"))
+    opt = optax.sgd(0.01)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, size=(batch, seq_len)).astype(np.int32)
+    tgts = shift_targets(toks)
+
+    out = {"pp": pp, "num_microbatches": num_microbatches, "batch": batch,
+           "seq_len": seq_len, "vocab": vocab,
+           "head_recompute_factor": round(
+               head_recompute_factor(pp, num_microbatches), 3)}
+    for schedule in ("gpipe", "1f1b"):
+        model = Model.init(spec, seed=0)
+        outer, blocks = split_block_params(model.params)
+        psh, osh = pp_state_shardings(mesh, opt, outer, blocks)
+        params = jax.device_put(
+            (jax.tree.map(jnp.asarray, outer), jax.tree.map(jnp.asarray, blocks)),
+            psh)
+        opt_state = jax.device_put(opt.init(params), osh)
+        step = make_pp_train_step(spec, opt, mesh, num_microbatches,
+                                  schedule=schedule)
+        dsh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp"))
+        tok_d = jax.device_put(toks, dsh)
+        tgt_d = jax.device_put(tgts, dsh)
+        state = {"p": params, "o": opt_state}
+
+        def run_once(state=state, step=step, tok_d=tok_d, tgt_d=tgt_d):
+            # donated params/opt_state: thread the new state through so
+            # every timed call uses live buffers
+            state["p"], state["o"], loss = step(state["p"], state["o"],
+                                                tok_d, tgt_d)
+            return loss
+
+        ms, spread, source = _device_time_ms(run_once, reps=reps)
+        out[schedule] = {"ms_per_step": round(ms, 2),
+                         "wall_spread": spread, "timing": source}
+    g, f = out["gpipe"]["ms_per_step"], out["1f1b"]["ms_per_step"]
+    if g:
+        out["1f1b_vs_gpipe"] = round(f / g, 4)
+    return out
+
+
 def _bench_moe(*, batch: int = 4, seq_len: int = 512, model_dim: int = 512,
                num_heads: int = 4, num_layers: int = 8, vocab: int = 8192,
                experts: int = 8, reps: int = 3):
@@ -1259,6 +1330,11 @@ def main() -> None:
                 out["moe"] = _bench_moe()
             except Exception as e:
                 out["moe"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["pipeline"] = _bench_pipeline()
+            except Exception as e:
+                out["pipeline"] = {"error": f"{type(e).__name__}: {e}"}
             gc.collect()
             try:
                 out["async"] = _bench_async()

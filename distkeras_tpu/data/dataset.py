@@ -11,15 +11,18 @@ axis over a mesh axis — the data plane feeds the chips directly.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu import utils
 
 
 def prefetch_to_device(chunks: Iterator, place: Callable,
-                       produce_ahead: bool = True) -> Iterator:
+                       produce_ahead: bool = True,
+                       metric_prefix: str = "feed") -> Iterator:
     """Double-buffered feed: yield ``place(chunk)`` with the NEXT chunk's
     host->device transfer already issued before the caller consumes the
     current one.
@@ -61,22 +64,67 @@ def prefetch_to_device(chunks: Iterator, place: Callable,
                     continue
             return False
 
+        # telemetry (no-op unless observability is enabled): producer-side
+        # chunk production latency (disk page faults + shuffle copies) and
+        # the handoff queue's occupancy — the feed path's two signals.
+        # ``metric_prefix`` keeps distinct producers in distinct
+        # instruments (the async trainer's window staging uses
+        # "async_feed" so its microsecond slice walk cannot pollute the
+        # disk feed's chunk-load histogram or flap its depth gauge)
+        m_load = obs.histogram(f"{metric_prefix}_chunk_load_seconds")
+        m_depth = obs.gauge(f"{metric_prefix}_queue_depth")
+        m_chunks = obs.counter(f"{metric_prefix}_chunks_total")
+
         def producer():
             try:
-                for c in source:
+                it_src = iter(source)
+                while True:
+                    telemetry = obs.enabled()
+                    t0 = time.perf_counter() if telemetry else 0.0
+                    try:
+                        c = next(it_src)
+                    except StopIteration:
+                        break
+                    if telemetry:
+                        m_load.observe(time.perf_counter() - t0)
+                        m_chunks.inc()
                     if not put(("chunk", c)):
                         return
+                    m_depth.set(q.qsize())
             except BaseException as exc:  # surfaced on the consumer side
                 put(("error", exc))
             else:
                 put(("done", None))
 
-        threading.Thread(target=producer, daemon=True).start()
+        producer_thread = threading.Thread(target=producer, daemon=True)
+        producer_thread.start()
 
         def produced():
             try:
                 while True:
-                    kind, val = q.get()
+                    # bounded wait + liveness check (ADVICE round 5): a
+                    # producer killed WITHOUT its sentinel (interpreter
+                    # teardown, an exception inside the sentinel put
+                    # itself) must surface as an error, not a silent
+                    # forever-hang in q.get()
+                    try:
+                        kind, val = q.get(timeout=1.0)
+                    except queue.Empty:
+                        if not producer_thread.is_alive():
+                            # one last non-blocking drain: the producer may
+                            # have enqueued its sentinel between our timeout
+                            # and the liveness check
+                            try:
+                                kind, val = q.get_nowait()
+                            except queue.Empty:
+                                raise RuntimeError(
+                                    "prefetch producer thread died without "
+                                    "delivering its chunk or end-of-epoch "
+                                    "sentinel; the feed cannot make progress"
+                                ) from None
+                        else:
+                            continue
+                    m_depth.set(q.qsize())
                     if kind == "error":
                         raise val
                     if kind == "done":

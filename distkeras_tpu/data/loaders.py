@@ -39,10 +39,12 @@ import os
 import pickle
 import struct
 import tarfile
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.data.dataset import Dataset
 
 
@@ -266,6 +268,23 @@ def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
           synthetic_sizes: Tuple[int, int], seed: int, cache_dir: Optional[str],
           synthetic_fallback: bool, flatten: bool, raw_finder=None,
           signal_amplitude: float = 7.0) -> Tuple[Dataset, Dataset, Dict]:
+    with obs.span("data.load", dataset=filename):
+        train, test, info = _load_inner(
+            filename, num_classes, image_shape, synthetic_sizes, seed,
+            cache_dir, synthetic_fallback, flatten, raw_finder,
+            signal_amplitude)
+    if obs.enabled():
+        obs.counter("data_loads_total", dataset=filename,
+                    synthetic=str(bool(info["synthetic"])).lower()).inc()
+    return train, test, info
+
+
+def _load_inner(filename: str, num_classes: int, image_shape: Tuple[int, ...],
+                synthetic_sizes: Tuple[int, int], seed: int,
+                cache_dir: Optional[str], synthetic_fallback: bool,
+                flatten: bool, raw_finder=None,
+                signal_amplitude: float = 7.0) -> Tuple[Dataset, Dataset, Dict]:
+    t0 = time.perf_counter()
     path = _find_npz(filename, cache_dir)
     raw = raw_source = None
     if path is None and raw_finder is not None:
@@ -294,6 +313,8 @@ def _load(filename: str, num_classes: int, image_shape: Tuple[int, ...],
             "and synthetic_fallback=False (this environment has no network access)")
     train, test = _to_datasets(xtr, ytr, xte, yte, num_classes, flatten)
     info.update(num_classes=num_classes, train_rows=len(train), test_rows=len(test))
+    if obs.enabled():
+        obs.histogram("data_load_seconds").observe(time.perf_counter() - t0)
     return train, test, info
 
 

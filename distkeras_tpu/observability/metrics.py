@@ -1,0 +1,326 @@
+"""Process-wide metrics registry: counters, gauges, log-bucket histograms.
+
+The measurement substrate ISSUE #1 asked for: the paper lineage's core
+quantities (staleness, commit rates, window wall-vs-device time — EASGD
+arXiv:1412.6651, "How to scale distributed deep learning?"
+arXiv:1611.04581) were computed all over the runtime and dropped on the
+floor; this registry is where every layer now records them.
+
+Design constraints (all load-bearing):
+
+- **Dependency-free.**  stdlib only — the punchcard daemon and the data
+  loaders must stay importable without jax, and the PS hub's handler
+  threads must not pull a metrics client library onto the commit path.
+- **Thread-safe.**  PS handler threads, async worker threads, the prefetch
+  producer and the snapshot daemon all write concurrently; every
+  instrument takes its own small lock.
+- **Near-zero when disabled.**  Telemetry is OFF by default: every mutator
+  is a single attribute check and early return, so instrumented hot paths
+  (per-RPC, per-window, per-chunk) cost one branch.  The ≤2% bench
+  overhead budget in ISSUE #1 is met by construction — nothing allocates,
+  formats, or locks until ``enable()`` has run.
+
+Naming convention (see ARCHITECTURE.md "Observability"): metric names are
+``<layer>_<quantity>[_<unit>|_total]`` — e.g. ``ps_commits_total``,
+``async_window_wall_seconds``, ``feed_queue_depth`` — with identity
+dimensions (worker index, trainer class) as labels, never baked into the
+name.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Fixed log-scale histogram bounds: 3 buckets per decade from 1e-6 to
+# ~1e8 (microseconds-as-seconds through day-long waits; also spans byte
+# counts when observed in MB).  FIXED — not configurable per histogram —
+# so every exported histogram is mergeable with every other and the
+# exposition format never needs per-metric schema.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    round(10.0 ** (exp10 + frac / 3.0), 10)
+    for exp10 in range(-6, 9)
+    for frac in range(3)
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_name(name: str, labels: _LabelKey) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` is a no-op while the owning registry is
+    disabled."""
+
+    __slots__ = ("name", "labels", "_registry", "_lock", "_value")
+
+    def __init__(self, name: str, labels: _LabelKey, registry: "MetricsRegistry"):
+        self.name = name
+        self.labels = labels
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._registry.enabled:
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Gauge:
+    """Last-written value (queue depths, staleness, rates)."""
+
+    __slots__ = ("name", "labels", "_registry", "_lock", "_value")
+
+    def __init__(self, name: str, labels: _LabelKey, registry: "MetricsRegistry"):
+        self.name = name
+        self.labels = labels
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Fixed log-scale-bucket histogram (see ``DEFAULT_BUCKETS``).
+
+    Stores per-bucket counts plus count/sum/min/max; ``observe`` is one
+    bisect + one locked increment.  Bucket counts are NON-cumulative
+    internally; snapshots/expositions render the Prometheus cumulative
+    ``le`` form.
+    """
+
+    __slots__ = ("name", "labels", "_registry", "_lock", "_counts",
+                 "_count", "_sum", "_min", "_max")
+
+    def __init__(self, name: str, labels: _LabelKey, registry: "MetricsRegistry"):
+        self.name = name
+        self.labels = labels
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(DEFAULT_BUCKETS) + 1)  # +1 overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        if not self._registry.enabled:
+            return
+        value = float(value)
+        # bisect_left: a value equal to a bound belongs to that bound's
+        # bucket (Prometheus ``le`` is inclusive)
+        idx = bisect_left(DEFAULT_BUCKETS, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self._counts)
+            out: Dict[str, object] = {
+                "count": self._count,
+                "sum": round(self._sum, 9),
+                "min": self._min,
+                "max": self._max,
+                "mean": (self._sum / self._count) if self._count else None,
+            }
+        # sparse cumulative buckets: only boundaries with mass, so a
+        # snapshot of many histograms stays a small JSON object
+        cum = 0
+        buckets: List[List[object]] = []
+        for i, c in enumerate(counts):
+            cum += c
+            if c:
+                le = DEFAULT_BUCKETS[i] if i < len(DEFAULT_BUCKETS) else "+Inf"
+                buckets.append([le, cum])
+        out["buckets"] = buckets
+        return out
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(DEFAULT_BUCKETS) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Get-or-create instrument store keyed by (name, labels).
+
+    One process-wide default instance lives in
+    ``distkeras_tpu.observability`` (module helpers ``counter``/``gauge``/
+    ``histogram`` bind to it); tests and embedded uses can construct
+    private always-enabled registries.
+    """
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple[str, _LabelKey], object] = {}
+        self._kinds: Dict[str, str] = {}
+
+    def _get(self, kind: str, name: str, labels: Dict[str, str]):
+        key = (name, _label_key(labels))
+        inst = self._instruments.get(key)
+        if inst is not None:
+            if type(inst) is not _KINDS[kind]:
+                raise TypeError(
+                    f"metric {name!r} already registered as a "
+                    f"{self._kinds[name]}, requested as a {kind}")
+            return inst
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                prev = self._kinds.get(name)
+                if prev is not None and prev != kind:
+                    raise TypeError(
+                        f"metric {name!r} already registered as a {prev}, "
+                        f"requested as a {kind}")
+                self._kinds[name] = kind
+                inst = _KINDS[kind](name, key[1], self)
+                self._instruments[key] = inst
+            return inst
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get("histogram", name, labels)
+
+    # -- introspection ---------------------------------------------------------
+    def instruments(self) -> List[object]:
+        with self._lock:
+            return list(self._instruments.values())
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        """Current value of a counter/gauge, None if never created (a
+        convenience for tests and snapshot consumers — does NOT create)."""
+        inst = self._instruments.get((name, _label_key(labels)))
+        return None if inst is None else getattr(inst, "value", None)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """JSON-safe point-in-time view::
+
+            {"counters":   {"ps_commits_total": 12.0, ...},
+             "gauges":     {'ps_staleness{conn="0"}': 3.0, ...},
+             "histograms": {"async_window_wall_seconds": {count, sum, min,
+                            max, mean, buckets: [[le, cumcount], ...]}, ...}}
+        """
+        out: Dict[str, Dict[str, object]] = {
+            "counters": {}, "gauges": {}, "histograms": {}}
+        for inst in self.instruments():
+            key = _render_name(inst.name, inst.labels)
+            if isinstance(inst, Counter):
+                out["counters"][key] = inst.value
+            elif isinstance(inst, Gauge):
+                out["gauges"][key] = inst.value
+            else:
+                out["histograms"][key] = inst.summary()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4, rendered on demand —
+        the pull-style sink (no server here; the punchcard daemon's
+        ``telemetry`` action and any embedding HTTP handler just return
+        this string)."""
+        by_name: Dict[str, List[object]] = {}
+        for inst in self.instruments():
+            by_name.setdefault(inst.name, []).append(inst)
+        lines: List[str] = []
+        for name in sorted(by_name):
+            kind = self._kinds[name]
+            lines.append(f"# TYPE {name} {kind}")
+            for inst in sorted(by_name[name], key=lambda i: i.labels):
+                if isinstance(inst, Histogram):
+                    s = inst.summary()
+                    cum = 0
+                    dense: Dict[object, int] = dict(
+                        (le, c) for le, c in s["buckets"])
+                    for le in list(DEFAULT_BUCKETS) + ["+Inf"]:
+                        if le in dense:
+                            cum = dense[le]
+                        labels = dict(inst.labels)
+                        labels["le"] = "+Inf" if le == "+Inf" else f"{le:g}"
+                        key = _render_name(name + "_bucket", _label_key(labels))
+                        lines.append(f"{key} {cum}")
+                    lines.append(
+                        f"{_render_name(name + '_sum', inst.labels)} {s['sum']}")
+                    lines.append(
+                        f"{_render_name(name + '_count', inst.labels)} {s['count']}")
+                else:
+                    lines.append(f"{_render_name(name, inst.labels)} {inst.value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Zero every instrument IN PLACE (tests; a fresh run's clean
+        slate).  Registrations are kept deliberately: hot paths are told to
+        cache instrument objects, so dropping them here would orphan those
+        references and silently lose all their subsequent writes."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            inst._zero()

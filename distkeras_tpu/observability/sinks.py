@@ -1,0 +1,97 @@
+"""Telemetry sinks: periodic JSONL flusher + Prometheus text exposition.
+
+Two pull/push shapes, both dependency-free:
+
+- :class:`JsonlFlusher` — a daemon thread that appends one JSON line per
+  interval to a file: ``{"ts": ..., "metrics": <registry snapshot>}``,
+  plus a ``"spans"`` list when a tracer is attached (spans are DRAINED —
+  each is flushed exactly once).  Crash-safe by construction: every line
+  is self-contained, so a truncated final line loses only itself.
+- :func:`render_prometheus` — the text exposition format, rendered on
+  demand (no HTTP server here; the punchcard daemon's ``telemetry``
+  action returns it, and any embedding web handler can too).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from distkeras_tpu.observability.metrics import MetricsRegistry
+from distkeras_tpu.observability.tracing import SpanTracer
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    return registry.render_prometheus()
+
+
+class JsonlFlusher:
+    """Periodic JSONL metrics/span flusher.
+
+    ``with JsonlFlusher(path, registry, tracer, interval=10): ...`` or
+    explicit ``start()``/``stop()``; ``stop()`` performs a final flush so
+    short runs always land at least one complete line.
+    """
+
+    def __init__(self, path: str, registry: MetricsRegistry,
+                 tracer: Optional[SpanTracer] = None,
+                 interval: float = 10.0):
+        if interval <= 0:
+            raise ValueError(f"interval must be positive, got {interval}")
+        self.path = path
+        self.registry = registry
+        self.tracer = tracer
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._write_lock = threading.Lock()
+
+    def flush(self) -> None:
+        line = {"ts": time.time(), "metrics": self.registry.snapshot()}
+        if self.tracer is not None:
+            spans = self.tracer.drain()
+            if spans:
+                line["spans"] = spans
+        # one locked append per flush: the periodic loop and a final
+        # stop()-flush must not interleave half-lines
+        with self._write_lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except OSError:
+                # a full/unmounted disk must not kill the training run the
+                # flusher is observing; the next interval retries
+                pass
+
+    def start(self) -> "JsonlFlusher":
+        if self._thread is not None:
+            raise RuntimeError("flusher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry-jsonl-flusher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self.flush()
+        except OSError:
+            # same contract as the periodic loop: a full/unmounted disk at
+            # shutdown must not crash the run the flusher was observing
+            pass
+
+    def __enter__(self) -> "JsonlFlusher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

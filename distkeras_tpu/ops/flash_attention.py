@@ -504,7 +504,11 @@ def _backward(q, k, v, o, lse, do, cfg: _Config, dlse=None):
         out_shape=_out_struct((b, h, lq, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=cfg.interpret,
-        compiler_params=_COMPILER_PARAMS,
+        # size the grant to THIS kernel's score tile too (ADVICE round 5):
+        # when the fused path is rejected with full-length forward-inherited
+        # blocks (large head_dim, Lq=Lk<=2048), the dq working set can
+        # outgrow the fixed 24M grant and fail Mosaic compilation
+        compiler_params=_bwd_compiler_params(bq, bk),
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(

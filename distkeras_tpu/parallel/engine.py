@@ -19,6 +19,7 @@ host round-trips, the commit is an ICI allreduce fused into the step.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -29,6 +30,7 @@ from flax import struct
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.models.base import Model, ModelSpec
 from distkeras_tpu.parallel.algorithms import Algorithm
 
@@ -318,18 +320,43 @@ class WindowEngine:
         (required iff the spec ``needs_rng``).
 
         Returns (new_state, per-window mean losses as numpy).
+
+        Telemetry (when ``distkeras_tpu.observability`` is enabled):
+        dispatch-to-completion time per compiled epoch-chunk program
+        (``engine_epoch_seconds`` — the ``np.asarray`` below blocks, so
+        the interval IS the program's effective duration incl. dispatch),
+        achieved throughput (``engine_samples_per_sec``) and the step
+        counter ``engine_steps_total``.
         """
-        xs_d, ys_d = self._place_data(xs, ys)
-        if keys is None:
-            # any constant is a valid (unused) threefry key when the spec
-            # has no rng need; a real run with needs_rng must pass keys
-            if self.needs_rng:
-                raise ValueError("this engine's spec needs per-batch dropout "
-                                 "keys; pass keys=[num_windows, window, 2]")
-            keys = np.zeros(xs.shape[:2] + (2,), np.uint32)
-        keys_d = self._place_keys(np.asarray(keys))
-        state, losses = self._epoch_fns[1](state, xs_d, ys_d, keys_d)
-        return state, np.asarray(losses)
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        with obs.span("engine.run_epoch", windows=int(np.shape(xs)[0]),
+                      replicas=self.num_replicas):
+            xs_d, ys_d = self._place_data(xs, ys)
+            if keys is None:
+                # any constant is a valid (unused) threefry key when the spec
+                # has no rng need; a real run with needs_rng must pass keys
+                if self.needs_rng:
+                    raise ValueError("this engine's spec needs per-batch dropout "
+                                     "keys; pass keys=[num_windows, window, 2]")
+                keys = np.zeros(xs.shape[:2] + (2,), np.uint32)
+            keys_d = self._place_keys(np.asarray(keys))
+            state, losses = self._epoch_fns[1](state, xs_d, ys_d, keys_d)
+            losses = np.asarray(losses)
+        if telemetry:
+            dt = time.perf_counter() - t0
+            num_windows, window, global_batch = (int(d) for d in np.shape(xs)[:3])
+            # identity as labels (ARCHITECTURE.md convention): a process
+            # with several engines (bench legs, elastic rebuilds) must not
+            # overwrite one unlabeled gauge or merge differently-shaped
+            # programs into one histogram
+            ident = {"model": self.spec.name,
+                     "replicas": str(self.num_replicas)}
+            obs.histogram("engine_epoch_seconds", **ident).observe(dt)
+            obs.counter("engine_steps_total", **ident).inc(num_windows * window)
+            obs.gauge("engine_samples_per_sec", **ident).set(
+                num_windows * window * global_batch / max(dt, 1e-9))
+        return state, losses
 
     def _place_keys(self, keys: np.ndarray):
         """Replicated placement for the per-batch key stream — a

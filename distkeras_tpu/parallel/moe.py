@@ -35,6 +35,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.models.base import ModelSpec, register_model
 
 import flax.linen as nn
@@ -287,7 +288,22 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                                 out_specs=(pspecs, ospecs, P(), P()))
         return sharded(params, opt_state, x, y)
 
-    return jax.jit(wrapped, donate_argnums=(0, 1))
+    jitted = jax.jit(wrapped, donate_argnums=(0, 1))
+
+    def step_with_telemetry(params, opt_state, x, y):
+        out = jitted(params, opt_state, x, y)
+        if obs.enabled():
+            # the stats the router always computed and the train loops
+            # used to discard: surfaced as gauges.  float() blocks on the
+            # step — only paid when telemetry is on
+            stats = out[3]
+            for stat_name in ("dropped_fraction", "max_expert_load"):
+                if stat_name in stats:
+                    obs.gauge(f"moe_{stat_name}").set(float(stats[stat_name]))
+            obs.counter("moe_steps_total").inc()
+        return out
+
+    return step_with_telemetry
 
 
 def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,

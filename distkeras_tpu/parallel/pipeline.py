@@ -74,6 +74,21 @@ def pp_param_specs(outer: Dict[str, Any], blocks: Any, pp_axis: str):
     return outer_specs, block_specs
 
 
+def head_recompute_factor(pp: int, num_microbatches: int) -> float:
+    """1F1B's head (+CE) evaluations per step relative to GPipe's.
+
+    GPipe evaluates the final-norm + unembed + softmax-CE once per
+    microbatch (M total); the 1F1B schedule's ``unit_scalar`` evaluates it
+    on every rank in every cycle (``pp`` ranks x ``M + 2(pp-1)`` cycles) —
+    the SPMD-inherent cost documented in :func:`make_pp_train_step`'s
+    ``"1f1b"`` notes.  The single definition shared by the docs, the bench
+    ``pipeline`` leg and the tests."""
+    if pp < 1 or num_microbatches < 1:
+        raise ValueError(f"pp and num_microbatches must be >= 1, got "
+                         f"{pp}, {num_microbatches}")
+    return pp * (1.0 + 2.0 * (pp - 1) / num_microbatches)
+
+
 def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                        mesh: Mesh, num_microbatches: int,
                        dp_axis: str = "dp", pp_axis: str = "pp",
@@ -100,6 +115,21 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
       way — non-interleaved 1F1B trades nothing for its memory bound).
       Pick it when M must grow (long sequences / small microbatches)
       and GPipe's O(M) residuals would not fit HBM.
+
+      **Per-cycle head-recompute cost (SPMD-inherent, ADVICE round 5):**
+      ``unit_scalar`` evaluates the final-norm + unembed matmul and the
+      vocab-wide softmax-CE (plus an embedding vjp) on EVERY rank in
+      EVERY cycle, with the ``where``-selected result masked away on all
+      but the last (resp. first) rank — that is how the head's gradient
+      stays inside one SPMD program without a separate last-rank
+      computation.  Relative to GPipe's single head evaluation per
+      microbatch, 1F1B spends roughly ``pp * (1 + 2*(pp-1)/M)`` times
+      the unembed FLOPs (``pp`` ranks each run it for ``M + 2(pp-1)``
+      cycles vs M microbatches once).  Negligible for small vocabularies;
+      at production vocab sizes it is a real tax on top of the memory
+      win — ``bench.py``'s ``pipeline`` leg records the measured
+      gpipe-vs-1f1b step time next to this analytic
+      ``head_recompute_factor`` so the tradeoff stays a number.
     """
     if spec.config.get("moe_experts"):
         raise ValueError("MoE FFN does not compose with pipeline parallelism "

@@ -35,13 +35,15 @@ finish.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu import observability as obs
+from distkeras_tpu.data.dataset import Dataset, prefetch_to_device
 from distkeras_tpu.models.base import Model
 from distkeras_tpu.parallel.engine import make_minibatch_step
 from distkeras_tpu.runtime.parameter_server import (
@@ -238,8 +240,25 @@ class AsyncDistributedTrainer(Trainer):
         def unflatten(flat: Sequence[np.ndarray]):
             return jax.tree.unflatten(treedef, list(flat))
 
+        # telemetry (near-zero when disabled): window wall vs DEVICE time
+        # histograms are the round-5 VERDICT hand measurement (371 ms wall
+        # vs 1.6 ms device per window) made permanent.  Occupancy is two
+        # monotonic counters (started minus finished = live workers); a
+        # worker records its finish only if it recorded its start, so
+        # enabling telemetry mid-run can never drive the difference
+        # negative (a disable mid-run leaves at most a one-run positive
+        # residual — the finish inc no-ops)
+        m_wall = obs.histogram("async_window_wall_seconds")
+        m_dev = obs.histogram("async_window_device_seconds")
+        m_windows = obs.counter("async_windows_total")
+        m_started = obs.counter("async_workers_started_total")
+        m_finished = obs.counter("async_workers_finished_total")
+
         def run_worker(idx: int) -> None:
             losses: List[Any] = []
+            start_counted = obs.enabled()
+            if start_counted:
+                m_started.inc()
             try:
                 device = devices[idx % len(devices)]
                 client = PSClient(ps_host, ps_port, templates=flat0,
@@ -257,19 +276,47 @@ class AsyncDistributedTrainer(Trainer):
                                                    [self.features_col, self.label_col],
                                                    window=self.communication_window)
                         xs, ys = stacked[self.features_col], stacked[self.label_col]
-                        for w in range(xs.shape[0]):
+                        # with telemetry ON, window slices ride the shared
+                        # feed machinery with a no-op place: the producer
+                        # thread stages (wx, wy) views one window ahead and
+                        # records the feed queue gauges, while the device
+                        # transfer itself STAYS fused with the pull below —
+                        # one batched H2D per window.  With telemetry off the
+                        # loop is the plain zero-thread slice walk (no queue
+                        # handoff on the hot path)
+                        slices = ((xs[w], ys[w]) for w in range(xs.shape[0]))
+                        feed = (prefetch_to_device(slices, lambda s: s,
+                                                   metric_prefix="async_feed")
+                                if obs.enabled() else slices)
+                        for w, (wx_h, wy_h) in enumerate(feed):
                             if self.fault_hook is not None:
                                 self.fault_hook(idx, w)
-                            # ONE batched H2D per window (center + feed
-                            # slices) — on a relayed device every transfer
-                            # call is a host round trip, so they are fused
-                            pulled, wx, wy = jax.device_put(
-                                (unflatten(client.pull()), xs[w], ys[w]), device)
-                            params, opt_state, commit, mloss = window_fn(
-                                params, opt_state, pulled, wx, wy)
-                            # one batched D2H for the payload; leaf order is
-                            # the same tree.flatten order as the templates
-                            client.commit(jax.tree.leaves(jax.device_get(commit)))
+                            telemetry = obs.enabled()
+                            t_wall = time.perf_counter() if telemetry else 0.0
+                            with obs.span("async.window", worker=idx,
+                                          epoch=epoch, window=w):
+                                # ONE batched H2D per window (center + feed
+                                # slices) — on a relayed device every transfer
+                                # call is a host round trip, so they are fused
+                                pulled, wx, wy = jax.device_put(
+                                    (unflatten(client.pull()), wx_h, wy_h), device)
+                                t_dev = time.perf_counter() if telemetry else 0.0
+                                params, opt_state, commit, mloss = window_fn(
+                                    params, opt_state, pulled, wx, wy)
+                                if telemetry:
+                                    # block on the window program ONLY when
+                                    # measuring: dispatch-to-completion is
+                                    # the device leg of the wall/device
+                                    # decomposition (the commit d2h below
+                                    # would serialize on it anyway)
+                                    jax.block_until_ready(mloss)
+                                    m_dev.observe(time.perf_counter() - t_dev)
+                                # one batched D2H for the payload; leaf order is
+                                # the same tree.flatten order as the templates
+                                client.commit(jax.tree.leaves(jax.device_get(commit)))
+                            if telemetry:
+                                m_wall.observe(time.perf_counter() - t_wall)
+                                m_windows.inc()
                             # loss stays a device scalar until the run ends:
                             # float() here would add one more blocking round
                             # trip per window
@@ -279,6 +326,8 @@ class AsyncDistributedTrainer(Trainer):
             except BaseException as e:  # surface worker crashes to the driver
                 errors.append(e)
             finally:
+                if start_counted:
+                    m_finished.inc()
                 # flush even on a mid-run crash: windows whose commits
                 # already reached the center must stay in history / the
                 # samples metric (the 'continue' failure policy counts on
@@ -342,7 +391,7 @@ class AsyncDistributedTrainer(Trainer):
         # interleave per-worker histories into one trace (order is arbitrary
         # under real asynchrony; per-worker order is preserved)
         for h in histories:
-            self.history.extend(h)
+            self._record_window_losses(h)
         total_windows = sum(len(h) for h in histories)
         self._record_epoch_metrics(
             epoch=self.num_epoch - 1,

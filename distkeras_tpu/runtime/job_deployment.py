@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.runtime import networking as net
 
 PROTOCOL_VERSION = 1
@@ -427,6 +428,22 @@ class Punchcard:
             net.send_json(conn, {"ok": True, "num_models": len(rec.model_blobs)})
             for blob in rec.model_blobs:
                 net.send_frame(conn, blob)
+        elif action == "telemetry":
+            # remote telemetry pull (ISSUE #1): a running job's metrics —
+            # PS counters, staleness gauges, window histograms, feed
+            # gauges — and optionally the span ring as a Chrome trace,
+            # readable WHILE the executor is mid-job (the registry and
+            # tracer are thread-safe; no job lock is taken)
+            resp: Dict[str, Any] = {
+                "ok": True,
+                "enabled": obs.enabled(),
+                "metrics": obs.snapshot(),
+            }
+            if req.get("prometheus"):
+                resp["prometheus"] = obs.render_prometheus()
+            if req.get("trace"):
+                resp["trace"] = obs.chrome_trace()
+            net.send_json(conn, resp)
         elif action == "shutdown":
             net.send_json(conn, {"ok": True})
             threading.Thread(target=self.stop, daemon=True).start()
@@ -648,12 +665,15 @@ class Punchcard:
                         continue  # cancelled while queued (finally still runs)
                     rec.state = RUNNING
                 self._save_record(rec)
-                self._run(rec)
+                with obs.span("punchcard.job", job_id=rec.job_id,
+                              trainer=rec.job.get("trainer")):
+                    self._run(rec)
                 rec.state = DONE
             except Exception as e:
                 rec.error = f"{type(e).__name__}: {e}"
                 rec.state = FAILED
             finally:
+                obs.counter("punchcard_jobs_total", state=rec.state).inc()
                 # a long-running daemon must not pin submitted datasets in
                 # RAM — cancelled ones included; only the fetchable model
                 # blobs outlive the run (and the spooled data.npz goes too).
@@ -808,6 +828,11 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._request({"action": "status", "job_id": self.job_id})
 
+    def telemetry(self, trace: bool = False) -> Dict[str, Any]:
+        """The daemon's live telemetry snapshot (see :func:`fetch_telemetry`);
+        daemon-wide, so it does not require this job to be submitted."""
+        return fetch_telemetry(self.host, self.port, self.secret, trace=trace)
+
     def cancel(self) -> str:
         if self.job_id is None:
             raise RuntimeError("job not submitted")
@@ -853,6 +878,19 @@ def list_jobs(host: str, port: int, secret: str) -> List[Dict[str, Any]]:
     """List all jobs known to a Punchcard daemon."""
     with _Conn(host, port, secret) as conn:
         return conn.request({"action": "list"})["jobs"]
+
+
+def fetch_telemetry(host: str, port: int, secret: str,
+                    trace: bool = False,
+                    prometheus: bool = False) -> Dict[str, Any]:
+    """Pull the daemon process's telemetry (authenticated): the metrics
+    snapshot, plus the span ring as Chrome ``trace_event`` JSON when
+    ``trace=True`` and the Prometheus text exposition when
+    ``prometheus=True``.  Works mid-job — this is how a running job's
+    counters/staleness/window histograms are read remotely."""
+    with _Conn(host, port, secret) as conn:
+        return conn.request({"action": "telemetry", "trace": bool(trace),
+                             "prometheus": bool(prometheus)})
 
 
 def shutdown(host: str, port: int, secret: str) -> None:

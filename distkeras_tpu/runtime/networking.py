@@ -44,6 +44,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distkeras_tpu import observability as obs
+
 MAX_FRAME = 1 << 34  # 16 GiB sanity bound on a single frame
 
 ACTION_PULL = b"P"
@@ -91,6 +93,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack(">Q", len(payload)) + payload)
+    # count only after sendall returned: a frame dropped by a dying peer
+    # must not inflate the tx accounting (mirrors the rx side's contract)
+    if obs.enabled():
+        obs.counter("net_tx_frames_total").inc()
+        obs.counter("net_tx_bytes_total").inc(8 + len(payload))
 
 
 def recv_frame(sock: socket.socket, limit: int = MAX_FRAME) -> bytes:
@@ -101,7 +108,13 @@ def recv_frame(sock: socket.socket, limit: int = MAX_FRAME) -> bytes:
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
     if n > limit:
         raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
-    return _recv_exact(sock, n)
+    payload = _recv_exact(sock, n)
+    # count only after the body fully arrived: a peer dying mid-frame must
+    # not inflate the byte accounting by data that never landed
+    if obs.enabled():
+        obs.counter("net_rx_frames_total").inc()
+        obs.counter("net_rx_bytes_total").inc(8 + n)
+    return payload
 
 
 # -- control plane: JSON frames -----------------------------------------------

@@ -29,15 +29,32 @@ import socket
 import threading
 from typing import List, Optional, Sequence
 
+import time
+
 import numpy as np
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.runtime import networking as net
 
 
 class SocketParameterServer:
     """Hub-and-spoke PS: one handler thread per worker connection, one lock
     around the center variable — the reference's concurrency model
-    (SURVEY §3.4), minus pickle and minus the GIL-heavy payload decode."""
+    (SURVEY §3.4), minus pickle and minus the GIL-heavy payload decode.
+
+    Telemetry (``distkeras_tpu.observability``, off by default): pull/
+    commit counts and payload bytes (``ps_pulls_total``,
+    ``ps_commits_total``, ``ps_pull_bytes_total``,
+    ``ps_commit_bytes_total``), per-RPC handler latency
+    (``ps_rpc_seconds{rpc=...}``) and the per-connection staleness gauge
+    ``ps_staleness{conn=N}`` (N is the hub's accept ordinal modulo 256 —
+    workers carry no identity on the wire, and the wrap bounds label
+    cardinality under elastic connection churn) — the commit clock the paper lineage's
+    staleness analysis (arXiv:1611.04581) is about, now a live signal
+    instead of a number internal to DynSGD's scaling rule.  Instruments
+    are looked up per RPC while telemetry is on (a dict get next to a
+    socket exchange) so a mid-run ``obs.reset()`` cannot orphan them, and
+    nothing is registered at all while telemetry is off."""
 
     def __init__(self, weights: Sequence[np.ndarray], host: str = "0.0.0.0", port: int = 0):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
@@ -50,6 +67,8 @@ class SocketParameterServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._handlers: List[threading.Thread] = []
         self._running = False
+        self._center_bytes = sum(w.nbytes for w in self.center)
+        self._conn_seq = 0  # connection ordinal -> staleness gauge label
 
     # -- lifecycle (reference: ParameterServer.start/stop) ---------------------
     def start(self) -> None:
@@ -87,7 +106,14 @@ class SocketParameterServer:
             except OSError:
                 break  # listener closed by stop()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._handle_connection, args=(conn,), daemon=True)
+            # ordinal wraps at a fixed slot count so the staleness gauge's
+            # label cardinality stays bounded even under elastic-run
+            # connection churn (ordinals already restart at 0 per hub,
+            # so slots only conflate workers past 256 live connections)
+            conn_idx = self._conn_seq % 256
+            self._conn_seq += 1
+            t = threading.Thread(target=self._handle_connection,
+                                 args=(conn, conn_idx), daemon=True)
             t.start()
             self._handlers.append(t)
 
@@ -109,18 +135,25 @@ class SocketParameterServer:
         return [net.dequantize_q_blob(np.asarray(blob).tobytes(), c.size).reshape(c.shape)
                 for blob, c in zip(blobs, self.center)]
 
-    def _handle_connection(self, conn: socket.socket) -> None:
+    def _handle_connection(self, conn: socket.socket, conn_idx: int = 0) -> None:
         last_pull_clock = 0
         try:
             while True:
                 # raw receive: pull/bye carry zero tensors, commit carries
                 # len(center) — decode against the center only on commit
                 action, blobs = net.recv_tensors(conn)
+                telemetry = obs.enabled()
+                t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
                     with self._lock:
                         snapshot = [w.copy() for w in self.center]
                         last_pull_clock = self._clock
                     net.send_tensors(conn, net.ACTION_WEIGHTS, snapshot)
+                    if telemetry:
+                        obs.counter("ps_pulls_total").inc()
+                        obs.counter("ps_pull_bytes_total").inc(self._center_bytes)
+                        obs.histogram("ps_rpc_seconds", rpc="pull").observe(
+                            time.perf_counter() - t0)
                 elif action in (net.ACTION_COMMIT, net.ACTION_QCOMMIT):
                     delta = (self._decode_delta(blobs)
                              if action == net.ACTION_COMMIT
@@ -131,6 +164,19 @@ class SocketParameterServer:
                         self.num_updates += 1
                         self._clock += 1
                     net.send_tensors(conn, net.ACTION_ACK, [])
+                    if telemetry:
+                        obs.counter("ps_commits_total").inc()
+                        obs.counter("ps_commit_bytes_total").inc(
+                            sum(np.asarray(b).nbytes for b in blobs))
+                        obs.histogram("ps_rpc_seconds", rpc="commit").observe(
+                            time.perf_counter() - t0)
+                        # per-connection staleness: commits the hub applied
+                        # between this worker's last pull and its commit —
+                        # the quantity DynSGD scales by, now visible for
+                        # EVERY hub flavor.  Created lazily so a hub with
+                        # telemetry off never registers per-connection state
+                        obs.gauge("ps_staleness",
+                                  conn=str(conn_idx)).set(staleness)
                 elif action == net.ACTION_BYE:
                     break
                 else:
@@ -207,13 +253,18 @@ class PSClient:
         self.sock = net.connect(host, port, timeout=timeout)
 
     def pull(self) -> List[np.ndarray]:
-        net.send_tensors(self.sock, net.ACTION_PULL, [])
-        action, tensors = net.recv_tensors(self.sock, templates=self.templates)
+        with obs.span("ps.pull"):
+            net.send_tensors(self.sock, net.ACTION_PULL, [])
+            action, tensors = net.recv_tensors(self.sock, templates=self.templates)
         if action != net.ACTION_WEIGHTS:
             raise ConnectionError(f"expected weights reply, got {action!r}")
         return tensors
 
     def commit(self, delta: Sequence[np.ndarray]) -> None:
+        with obs.span("ps.commit", compress=self.compress or "none"):
+            self._commit(delta)
+
+    def _commit(self, delta: Sequence[np.ndarray]) -> None:
         new_residuals = None
         if self.compress == "int8":
             action, arrays, new_residuals = net.ACTION_QCOMMIT, [], []

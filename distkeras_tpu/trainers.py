@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import observability as obs
 from distkeras_tpu.checkpoint import Checkpointer
 from distkeras_tpu.data.dataset import Dataset, prefetch_to_device
 from distkeras_tpu.models.base import Model, ModelSpec
@@ -247,15 +248,37 @@ class Trainer:
                               chips: int = 1) -> None:
         """``chips`` = devices this trainer actually engaged — NOT
         ``jax.device_count()``, which would under-report per-chip rate when
-        fewer replicas than visible devices are in use."""
+        fewer replicas than visible devices are in use.
+
+        Mirrored into the process telemetry registry (when enabled) under
+        the ``trainer`` label, so a snapshot pulled off a running job sees
+        the same per-epoch numbers this list accumulates."""
+        rate = round(samples / max(seconds, 1e-9) / max(chips, 1), 1)
         self.metrics.append({
             "epoch": epoch,
             "samples": int(samples),
             "seconds": round(seconds, 4),
             "chips": int(chips),
-            "samples_per_sec_per_chip": round(samples / max(seconds, 1e-9)
-                                              / max(chips, 1), 1),
+            "samples_per_sec_per_chip": rate,
         })
+        if obs.enabled():
+            name = type(self).__name__
+            obs.counter("trainer_epochs_total", trainer=name).inc()
+            obs.counter("trainer_samples_total", trainer=name).inc(samples)
+            obs.histogram("trainer_epoch_seconds", trainer=name).observe(seconds)
+            obs.gauge("trainer_samples_per_sec_per_chip", trainer=name).set(rate)
+
+    def _record_window_losses(self, losses) -> None:
+        """Append per-window mean losses to ``history`` and (when telemetry
+        is on) the ``trainer_window_loss`` histogram — the loss trace's
+        queryable form."""
+        values = [float(x) for x in np.asarray(losses).ravel()]
+        self.history.extend(values)
+        if obs.enabled() and values:
+            hist = obs.histogram("trainer_window_loss",
+                                 trainer=type(self).__name__)
+            for v in values:
+                hist.observe(v)
 
 
 class SingleTrainer(Trainer):
@@ -327,16 +350,18 @@ class SingleTrainer(Trainer):
                                      [self.features_col, self.label_col],
                                      window=1, chunk_windows=self.chunk_windows),
                     place)
-                for chunk_idx, (xs, ys) in enumerate(placed):
-                    if needs_rng:
-                        keys = self._batch_keys(epoch, chunk_idx, (xs.shape[0],))
-                        params, opt_state, losses = epoch_fn(
-                            params, opt_state, xs, ys, jnp.asarray(keys))
-                    else:
-                        params, opt_state, losses = epoch_fn(params, opt_state,
-                                                             xs, ys)
-                    self.history.extend(np.asarray(losses).tolist())
-                    samples += xs.shape[0] * xs.shape[1]
+                with obs.span("trainer.epoch", trainer=type(self).__name__,
+                              epoch=epoch):
+                    for chunk_idx, (xs, ys) in enumerate(placed):
+                        if needs_rng:
+                            keys = self._batch_keys(epoch, chunk_idx, (xs.shape[0],))
+                            params, opt_state, losses = epoch_fn(
+                                params, opt_state, xs, ys, jnp.asarray(keys))
+                        else:
+                            params, opt_state, losses = epoch_fn(params, opt_state,
+                                                                 xs, ys)
+                        self._record_window_losses(losses)
+                        samples += xs.shape[0] * xs.shape[1]
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch, chips=1)
                 val = self._validate(params, validation_data)
                 if val:
@@ -449,14 +474,16 @@ class DistributedTrainer(Trainer):
                                      chunk_windows=self.chunk_windows),
                     lambda ch: engine.place_data(ch[self.features_col],
                                                  ch[self.label_col]))
-                for chunk_idx, (xs_d, ys_d) in enumerate(placed):
-                    keys = None
-                    if engine.needs_rng:
-                        keys = self._batch_keys(epoch, chunk_idx, xs_d.shape[:2])
-                    state, losses = engine.run_epoch(state, xs_d, ys_d, keys=keys)
-                    self.history.extend(losses.tolist())
-                    samples += (xs_d.shape[0]
-                                * self.communication_window * global_batch)
+                with obs.span("trainer.epoch", trainer=type(self).__name__,
+                              epoch=epoch):
+                    for chunk_idx, (xs_d, ys_d) in enumerate(placed):
+                        keys = None
+                        if engine.needs_rng:
+                            keys = self._batch_keys(epoch, chunk_idx, xs_d.shape[:2])
+                        state, losses = engine.run_epoch(state, xs_d, ys_d, keys=keys)
+                        self._record_window_losses(losses)
+                        samples += (xs_d.shape[0]
+                                    * self.communication_window * global_batch)
                 self._record_epoch_metrics(epoch, samples, time.time() - t_epoch,
                                            chips=self.num_workers)
                 if validation_data is not None:
