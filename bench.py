@@ -804,15 +804,26 @@ def _bench_ring(l_local: int, *, batch: int = 1, heads: int = 8,
     }
 
 
-def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
-                chunks: int = 6, reps: int = 3):
-    """Feed-path overlap: one chunked MNIST-CNN epoch timed three ways —
+def _bench_feed(*, batch: int = 1024, total_batches: int = 96, reps: int = 3,
+                sweep_batches_per_chunk=(4, 8, 16, 32), sweep_reps: int = 2):
+    """Feed-path overlap: chunked MNIST-CNN epochs timed three ways —
     all chunks pre-placed on device (pure compute), sequential
     place-then-train (the pre-round-5 loop), and the double-buffered
-    ``prefetch_to_device`` loop the trainers now use.  ``feed_overhead``
-    = 1 - compute/wall for each loop; the prefetch column is the number
-    the round-4 verdict asked for (weak #6: no H2D/compute overlap)."""
+    ``prefetch_to_device`` loop the trainers use.  ``feed_overhead``
+    = 1 - compute/wall for each loop.
+
+    Round-6 additions (verdict weak #4/#6): (1) a ``chunk_mb`` SWEEP —
+    the same ``total_batches`` of data fed as 4/8/16/32-batch chunks
+    (~12/25/49/98 MB) through the prefetch loop; the fastest size is
+    promoted IN-RUN to be the config of the headline three-way
+    comparison and recorded as ``best_chunk_mb`` (the measured value
+    behind ``data.dataset.DEFAULT_CHUNK_BUDGET_BYTES``); (2) a per-chunk
+    ``decomposition`` — IO (producing the host chunk), wire (blocking
+    H2D place), and step wall vs on-device time (profiler trace) from an
+    instrumented sequential pass, so "it's the relay" is a measured
+    split, not an inference from totals."""
     import statistics
+    import tempfile
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -830,11 +841,14 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
     epoch_fn = scan_epoch_fn(spec.apply_fn(), get_loss("categorical_crossentropy"), opt)
 
     rng = np.random.default_rng(0)
-    host_chunks = [
-        (rng.normal(size=(batches_per_chunk, batch, 28, 28, 1)).astype(np.float32),
-         np.eye(10, dtype=np.float32)[rng.integers(0, 10, (batches_per_chunk, batch))])
-        for _ in range(chunks)
-    ]
+    data_x = rng.normal(size=(total_batches, batch, 28, 28, 1)).astype(np.float32)
+    data_y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (total_batches, batch))]
+
+    def make_chunks(per_chunk):
+        n = (total_batches // per_chunk) * per_chunk
+        return [(data_x[i:i + per_chunk], data_y[i:i + per_chunk])
+                for i in range(0, n, per_chunk)]
+
     params0 = jax.tree.map(jnp.array, model.params)
     opt_state0 = opt.init(params0)
 
@@ -846,11 +860,10 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
             np.asarray(losses)  # the trainer's per-chunk history read
 
     place = lambda ch: (jnp.asarray(ch[0]), jnp.asarray(ch[1]))
-    run_chunks(prefetch_to_device(iter(host_chunks), place))  # compile + warm
 
-    def timed(make_iter):
+    def timed(make_iter, n_reps=reps):
         walls = []
-        for _ in range(reps):
+        for _ in range(n_reps):
             it = make_iter()
             t0 = time.perf_counter()
             run_chunks(it)
@@ -859,6 +872,32 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
         spread = round((max(walls) - min(walls)) / med, 3) if med else 0.0
         return med, spread
 
+    # -- chunk-size sweep (prefetch loop; each size recompiles the epoch
+    # program once for its [per_chunk, batch, ...] shape).  A non-divisor
+    # size trains only the divisible prefix of the data, so every leg's
+    # samples_per_sec counts its OWN trained samples and the promotion
+    # compares throughput, not wall over unequal work ----------------------
+    sweep = []
+    for per_chunk in sweep_batches_per_chunk:
+        host_chunks = make_chunks(per_chunk)
+        leg_samples = len(host_chunks) * per_chunk * batch
+        run_chunks(prefetch_to_device(iter(host_chunks), place))  # compile+warm
+        t_pre, sp = timed(lambda hc=host_chunks: prefetch_to_device(iter(hc), place),
+                          n_reps=sweep_reps)
+        sweep.append({
+            "batches_per_chunk": per_chunk,
+            "chunk_mb": round(host_chunks[0][0].nbytes / 2**20, 1),
+            "prefetch_ms": round(t_pre * 1e3, 1),
+            "samples_per_sec": round(leg_samples / t_pre, 1),
+            "spread": sp,
+        })
+    best = max(sweep, key=lambda s: s["samples_per_sec"])
+    best_per_chunk = best["batches_per_chunk"]
+
+    # -- headline three-way comparison AT the promoted best size -----------
+    host_chunks = make_chunks(best_per_chunk)
+    chunks = len(host_chunks)
+    samples = chunks * best_per_chunk * batch  # what these loops train on
     pre_placed = [place(ch) for ch in host_chunks]
     jax.block_until_ready(pre_placed)
     t_compute, sp_c = timed(lambda: iter(pre_placed))
@@ -866,7 +905,41 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
     # transfer-after-previous-chunk-completes behavior
     t_seq, sp_s = timed(lambda: (place(c) for c in host_chunks))
     t_pre, sp_p = timed(lambda: prefetch_to_device(iter(host_chunks), place))
-    samples = chunks * batches_per_chunk * batch
+
+    # -- per-chunk decomposition (instrumented sequential pass): IO is the
+    # host-side chunk production (a copy here — synthetic data stands in
+    # for the page-fault cost a ColumnFile feed pays), wire is the
+    # BLOCKING place, step is the train call; device time comes from the
+    # module events of a trace around the pass.  Blocking on the place
+    # defeats overlap by design — this pass measures the parts, the timed
+    # loops above measure the composition
+    io_ms, wire_ms, step_ms = [], [], []
+    params = jax.tree.map(jnp.array, params0)
+    opt_state = jax.tree.map(jnp.array, opt_state0)
+    with tempfile.TemporaryDirectory() as td:
+        with jax.profiler.trace(td):
+            for xs_h, ys_h in host_chunks:
+                t0 = time.perf_counter()
+                xs_h, ys_h = np.array(xs_h), np.array(ys_h)  # produce
+                t1 = time.perf_counter()
+                placed = place((xs_h, ys_h))
+                jax.block_until_ready(placed)
+                t2 = time.perf_counter()
+                params, opt_state, losses = epoch_fn(params, opt_state, *placed)
+                np.asarray(losses)
+                t3 = time.perf_counter()
+                io_ms.append((t1 - t0) * 1e3)
+                wire_ms.append((t2 - t1) * 1e3)
+                step_ms.append((t3 - t2) * 1e3)
+        dev_ms = sum(_trace_jit_durs(td))
+    med = statistics.median
+    decomposition = {
+        "io_ms_per_chunk": round(med(io_ms), 2),
+        "wire_ms_per_chunk": round(med(wire_ms), 2),
+        "step_wall_ms_per_chunk": round(med(step_ms), 2),
+        "device_ms_per_chunk": round(dev_ms / max(chunks, 1), 2),
+    }
+
     # NOTE (relay platforms): the transfer legs ride a SHARED relay whose
     # bandwidth swings >2x with tenancy — the sequential/prefetch
     # comparison is only meaningful when their spreads are small; the
@@ -874,6 +947,8 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
     return {
         "chunks": chunks,
         "chunk_mb": round(host_chunks[0][0].nbytes / 2**20, 1),
+        "best_chunk_mb": best["chunk_mb"],
+        "sweep": sweep,
         "timing": "wall",
         "compute_only_ms": round(t_compute * 1e3, 1),
         "sequential_ms": round(t_seq * 1e3, 1),
@@ -882,6 +957,7 @@ def _bench_feed(*, batch: int = 1024, batches_per_chunk: int = 16,
         "feed_overhead_sequential": round(max(0.0, 1 - t_compute / t_seq), 4),
         "feed_overhead_prefetch": round(max(0.0, 1 - t_compute / t_pre), 4),
         "samples_per_sec_prefetch": round(samples / t_pre, 1),
+        "decomposition": decomposition,
     }
 
 
@@ -890,14 +966,13 @@ def _bench_pipeline(*, pp: int = 2, num_microbatches: int = 8, batch: int = 8,
                     num_heads: int = 2, num_layers: int = 4,
                     vocab: int = 8192, reps: int = 3):
     """GPipe vs 1F1B step time on a (dp=1, pp) mesh, with the analytic
-    ``head_recompute_factor`` recorded next to the measurement (ADVICE
-    round 5): 1F1B's ``unit_scalar`` runs the final-norm + unembed +
-    vocab-wide softmax-CE on every rank every cycle with the result
-    masked away on all but one rank — roughly ``pp * (1 + 2(pp-1)/M)``
-    times GPipe's unembed FLOPs.  The leg makes the memory-for-FLOPs
-    tradeoff a recorded number instead of a docstring claim (the factor
-    grows with vocab share, so re-run at production vocab before picking
-    a schedule)."""
+    ``head_recompute_factor`` recorded next to the measurement.  Since
+    round 6 the 1F1B head + CE runs inside a ``lax.cond`` taken only on
+    the last rank's valid backward units, so the factor is 1.0 (same
+    unembed FLOPs as GPipe); the round-5 ``jnp.where`` form paid
+    ``pp * (1 + 2(pp-1)/M)`` times GPipe's and lost at every measured M.
+    The leg keeps both numbers recorded so a schedule regression trips
+    as a measurement, not a docstring drift."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -956,20 +1031,110 @@ def _bench_pipeline(*, pp: int = 2, num_microbatches: int = 8, batch: int = 8,
     return out
 
 
+def _bench_moe_capacity_sweep(*, model_dim: int, num_heads: int, vocab: int,
+                              experts: int, batch: int, seq_len: int,
+                              num_layers: int, steps: int, factors,
+                              aux_weight: float = 0.01):
+    """Trained-router drop rates across capacity factors (satellite of the
+    sparse-dispatch issue): the recorded ``dropped_fraction`` numbers were
+    UNTRAINED-router worst cases (18-30% at factor 2, BENCH_r05) — the
+    load-balance aux loss exists precisely to push them toward zero, so
+    this sweep trains the MoE LM (adam, ``steps`` batches of fresh random
+    tokens, one compiled scan per factor) and records the drop/load stats
+    at the START and END of training for each factor.  Runs on the sorted
+    dispatch path at a compact depth (the routing statistics are
+    per-layer; depth only multiplies identical routers)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import small_lm_spec
+    from distkeras_tpu.parallel.moe import _collect_router_stats
+
+    t = batch * seq_len
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, size=(steps, batch, seq_len)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=-1)  # CE below drops the last position
+
+    results = []
+    for factor in factors:
+        cap = max(1, -(-int(factor * t) // experts))
+        spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
+                             num_heads=num_heads, num_layers=num_layers,
+                             max_seq_len=seq_len, moe_experts=experts,
+                             moe_capacity=cap, moe_top_k=1,
+                             moe_dispatch="sorted")
+        module = spec.build()
+        opt = optax.adam(3e-3)
+
+        def loss_fn(params, tok, tgt, module=module):
+            logits, variables = module.apply(
+                {"params": params}, tok, mutable=["aux_loss", "router_stats"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), tgt.astype(jnp.int32))[:, :-1].mean()
+            aux_leaves = jax.tree.leaves(variables.get("aux_loss", {}))
+            aux = sum(aux_leaves) / len(aux_leaves)
+            stats = {k: sum(v) / len(v) for k, v in _collect_router_stats(
+                variables.get("router_stats", {})).items()}
+            return ce + aux_weight * aux, stats
+
+        @jax.jit
+        def train(params, opt_state, toks_d, tgts_d, opt=opt, loss_fn=loss_fn):
+            def body(carry, data):
+                params, opt_state = carry
+                (loss, stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, *data)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state), (
+                    loss, stats["dropped_fraction"], stats["max_expert_load"])
+
+            _, ys = jax.lax.scan(body, (params, opt_state), (toks_d, tgts_d))
+            return ys
+
+        model = Model.init(spec, seed=0)
+        params = jax.tree.map(jnp.asarray, model.params)
+        losses, drops, loads = train(params, opt.init(params),
+                                     jnp.asarray(toks), jnp.asarray(tgts))
+        drops, loads = np.asarray(drops), np.asarray(loads)
+        tail = max(1, min(5, steps // 4))
+        results.append({
+            "capacity_factor": factor,
+            "capacity": cap,
+            "dropped_fraction_untrained": round(float(np.mean(drops[:tail])), 4),
+            "dropped_fraction_trained": round(float(np.mean(drops[-tail:])), 4),
+            "max_expert_load_trained": round(float(np.mean(loads[-tail:])), 3),
+            "final_loss": round(float(np.asarray(losses)[-1]), 4),
+            "train_steps": steps,
+        })
+    return results
+
+
 def _bench_moe(*, batch: int = 4, seq_len: int = 512, model_dim: int = 512,
                num_heads: int = 4, num_layers: int = 8, vocab: int = 8192,
-               experts: int = 8, reps: int = 3):
+               experts: int = 8, reps: int = 3, sweep_layers: int = 2,
+               sweep_steps: int = 150,
+               capacity_factors=(1.0, 1.25, 1.5, 2.0)):
     """Switch-MoE TransformerLM train step (make_moe_lm_train_step) on the
     real chip: tokens/sec + expert-FLOP-accounted MFU for top-1 (Switch)
-    and top-2 (GShard-style) routing, with the router stats surfaced.
+    and top-2 (GShard-style) routing — each under BOTH dispatch impls
+    (``top1``/``top2`` run the sorted gather path, ``top1_dense``/
+    ``top2_dense`` the round-5 one-hot einsums, so the dispatch-tax
+    removal is an A/B number, not a claim) — plus the trained-router
+    capacity-factor sweep and the issue-2 acceptance tripwires.
 
     MFU accounting: the model-required matmul FLOPs — dense projections,
     causal attention, unembed, router, and the EXECUTED expert compute
     (E * capacity slots through up/down, i.e. the capacity-padded slabs
-    the MXU actually runs, x3 for fwd+bwd) — over device time.  The
-    one-hot dispatch/combine einsums are ROUTING OVERHEAD, excluded from
-    MFU but reported as ``dispatch_flops_pct`` so the cost of the
-    static-shape dispatch design is a number."""
+    the MXU actually runs, x3 for fwd+bwd) — over device time.  Dispatch/
+    combine work is ROUTING OVERHEAD, excluded from MFU and reported as
+    ``dispatch_flops_pct`` per impl (``parallel.moe.dispatch_matmul_flops``
+    is the single source of truth: 4·T·E·C·D dense, 0 sorted).  This
+    field's denominator is the whole MODEL's matmul FLOPs (attention +
+    unembed included); the train step's sown stat of the same name is
+    MoE-layer-local and therefore reads higher under dense dispatch —
+    both are exactly 0 on the sorted path."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -978,7 +1143,8 @@ def _bench_moe(*, batch: int = 4, seq_len: int = 512, model_dim: int = 512,
     from distkeras_tpu.models.base import Model
     from distkeras_tpu.models.transformer import small_lm_spec
     from distkeras_tpu.parallel.mesh import create_nd_mesh
-    from distkeras_tpu.parallel.moe import (make_moe_lm_train_step,
+    from distkeras_tpu.parallel.moe import (dispatch_matmul_flops,
+                                            make_moe_lm_train_step,
                                             moe_data_sharding,
                                             moe_state_shardings)
     from distkeras_tpu.parallel.lm import shift_targets
@@ -1001,53 +1167,93 @@ def _bench_moe(*, batch: int = 4, seq_len: int = 512, model_dim: int = 512,
     router_fl = 3 * 2 * t * e * experts
     unembed_fl = 3 * 2 * t * e * vocab
     model_fl = num_layers * (expert_fl + attn_proj_fl + router_fl) + unembed_fl
-    dispatch_fl = num_layers * 3 * (4 * t * experts * cap * e)
 
     out = {"batch": batch, "seq_len": seq_len, "experts": experts,
            "capacity": cap}
     for top_k in (1, 2):
-        spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
-                             num_heads=num_heads, num_layers=num_layers,
-                             max_seq_len=seq_len, moe_experts=experts,
-                             moe_top_k=top_k)
-        model = Model.init(spec, seed=0)
-        opt = optax.sgd(0.01)
-        step = make_moe_lm_train_step(spec, opt, mesh)
-        psh, osh = moe_state_shardings(mesh, opt, model.params)
-        params = jax.device_put(jax.tree.map(jnp.asarray, model.params), psh)
-        opt_state = jax.device_put(opt.init(params), osh)
-        dsh = moe_data_sharding(mesh)
-        tok_d, tgt_d = jax.device_put(toks, dsh), jax.device_put(tgts, dsh)
-        state = {"p": params, "o": opt_state, "stats": None}
+        for impl in ("sorted", "dense"):
+            dispatch_fl = num_layers * 3 * dispatch_matmul_flops(
+                t, experts, cap, e, impl)
+            spec = small_lm_spec(vocab_size=vocab, model_dim=model_dim,
+                                 num_heads=num_heads, num_layers=num_layers,
+                                 max_seq_len=seq_len, moe_experts=experts,
+                                 moe_top_k=top_k, moe_dispatch=impl)
+            model = Model.init(spec, seed=0)
+            opt = optax.sgd(0.01)
+            step = make_moe_lm_train_step(spec, opt, mesh)
+            psh, osh = moe_state_shardings(mesh, opt, model.params)
+            params = jax.device_put(jax.tree.map(jnp.asarray, model.params), psh)
+            opt_state = jax.device_put(opt.init(params), osh)
+            dsh = moe_data_sharding(mesh)
+            tok_d, tgt_d = jax.device_put(toks, dsh), jax.device_put(tgts, dsh)
+            state = {"p": params, "o": opt_state, "stats": None}
 
-        def run_once(state=state, step=step, tok_d=tok_d, tgt_d=tgt_d):
-            # donated params/opt_state: thread the NEW state through so
-            # every call uses live buffers
-            state["p"], state["o"], loss, state["stats"] = step(
-                state["p"], state["o"], tok_d, tgt_d)
-            return loss
+            def run_once(state=state, step=step, tok_d=tok_d, tgt_d=tgt_d):
+                # donated params/opt_state: thread the NEW state through so
+                # every call uses live buffers
+                state["p"], state["o"], loss, state["stats"] = step(
+                    state["p"], state["o"], tok_d, tgt_d)
+                return loss
 
-        ms, spread, source = _device_time_ms(run_once, reps=reps)
-        sec = ms / 1e3
-        out[f"top{top_k}"] = {
-            "tokens_per_sec": round(t / sec, 1),
-            "ms_per_step": round(ms, 2),
-            "mfu": round(model_fl / sec / peak, 4) if peak else None,
-            "dispatch_flops_pct": round(100 * dispatch_fl / (model_fl + dispatch_fl), 1),
-            "dropped_fraction": round(float(state["stats"]["dropped_fraction"]), 4),
-            "max_expert_load": round(float(state["stats"]["max_expert_load"]), 3),
-            "wall_spread": spread,
-            "timing": source,
-        }
+            ms, spread, source = _device_time_ms(run_once, reps=reps)
+            sec = ms / 1e3
+            name = f"top{top_k}" if impl == "sorted" else f"top{top_k}_dense"
+            out[name] = {
+                "tokens_per_sec": round(t / sec, 1),
+                "ms_per_step": round(ms, 2),
+                "mfu": round(model_fl / sec / peak, 4) if peak else None,
+                "dispatch_impl": impl,
+                "dispatch_flops_pct": round(
+                    100 * dispatch_fl / (model_fl + dispatch_fl), 1),
+                "dropped_fraction": round(float(state["stats"]["dropped_fraction"]), 4),
+                "max_expert_load": round(float(state["stats"]["max_expert_load"]), 3),
+                "wall_spread": spread,
+                "timing": source,
+            }
+    for top_k in (1, 2):
+        s, d = out[f"top{top_k}"], out[f"top{top_k}_dense"]
+        out[f"sorted_vs_dense_top{top_k}"] = round(
+            s["tokens_per_sec"] / d["tokens_per_sec"], 4)
+
+    try:
+        out["capacity_sweep"] = _bench_moe_capacity_sweep(
+            model_dim=model_dim, num_heads=num_heads, vocab=vocab,
+            experts=experts, batch=batch, seq_len=seq_len,
+            num_layers=sweep_layers, steps=sweep_steps,
+            factors=capacity_factors)
+    except Exception as ex:
+        out["capacity_sweep"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    # issue-2 acceptance tripwires, recorded as booleans so a regression
+    # (or an unmet target) is a grep-able field, not a judgement call
+    sweep = out["capacity_sweep"] if isinstance(out["capacity_sweep"], list) else []
+    by_factor = {s["capacity_factor"]: s for s in sweep}
+    trained_drop = by_factor.get(2.0, {}).get("dropped_fraction_trained")
+    t1 = out["top1"]
+    out["acceptance"] = {
+        "mfu_target": 0.45,
+        "mfu_ok": None if t1.get("mfu") is None else bool(t1["mfu"] >= 0.45),
+        "dispatch_pct_target": 20.0,
+        "dispatch_pct_ok": bool(t1["dispatch_flops_pct"] < 20.0),
+        "trained_drop_target": 0.05,
+        "trained_drop_ok": (None if trained_drop is None
+                            else bool(trained_drop < 0.05)),
+    }
     return out
 
 
 def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
-                 windows_per_epoch: int = 8, epochs: int = 3):
+                 windows_per_epoch: int = 8, epochs: int = 3,
+                 scaling_workers=(1, 4)):
     """Genuinely-async trainer family (runtime/async_trainer.py) on the
-    real chip: AsyncADAG and AsyncAEASGD wall throughput vs the sync
-    window engine's, with the device-time share of the async wall so the
-    dispatch overhead is a measured number, not a guess.
+    real chip: AsyncADAG (Python hub, C++ hub, int8 Q-commits) and
+    AsyncAEASGD wall throughput vs the sync window engine's, with the
+    device-time share of the async wall so the dispatch overhead is a
+    measured number, not a guess — plus a worker-scaling sweep (weak
+    scaling: per-worker data held constant).  The ``native`` and ``int8``
+    legs are the round-5 verdict's missing evidence: the C++ hub and the
+    4x-smaller Q-commits existed with correctness tests only; these legs
+    put wall/device numbers (and a tripwire) on each.
 
     Methodology: each trainer runs train() TWICE on the same instance —
     the first run compiles (the window program is cached per instance),
@@ -1072,14 +1278,18 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
 
     spec = mnist_cnn_spec()
     rng = np.random.default_rng(0)
-    n = workers * batch * window * windows_per_epoch
-    ds = Dataset({
-        "features": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
-        "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)],
-    })
+
+    def make_ds(w):
+        n = w * batch * window * windows_per_epoch
+        return n, Dataset({
+            "features": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+            "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)],
+        })
+
+    n, ds = make_ds(workers)
     samples = n * epochs
 
-    def timed_run(trainer):
+    def timed_run(trainer, ds=ds):
         trainer.train(ds, shuffle=False)  # compile + warm
         trainer.model = Model.init(spec, seed=0)
         trainer.history = []  # count only the timed run's windows
@@ -1098,19 +1308,57 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
     kwargs = dict(loss="categorical_crossentropy", batch_size=batch,
                   num_epoch=epochs, learning_rate=0.01, seed=0)
 
-    for name, cls, extra in (("async_adag", AsyncADAG, {}),
-                             ("async_aeasgd", AsyncAEASGD, {"rho": 2.0})):
-        tr = cls(Model.init(spec, seed=0), num_workers=workers,
+    def async_leg(name, cls, extra, w=workers, leg_ds=None, leg_samples=None):
+        tr = cls(Model.init(spec, seed=0), num_workers=w,
                  communication_window=window, **dict(kwargs, **extra))
-        wall, dev_ms = timed_run(tr)
+        wall, dev_ms = timed_run(tr, ds=leg_ds if leg_ds is not None else ds)
         n_windows = len(tr.history)
         out[name] = {
-            "samples_per_sec": round(samples / wall, 1),
+            "samples_per_sec": round((leg_samples or samples) / wall, 1),
             "wall_s": round(wall, 3),
             "device_share": round(dev_ms / 1e3 / wall, 4),
             "per_window_wall_ms": round(wall * 1e3 / max(n_windows, 1), 2),
             "per_window_device_ms": round(dev_ms / max(n_windows, 1), 2),
+            "hub": "native" if extra.get("native_ps") else "python",
+            "compress": extra.get("compress_commits"),
         }
+        return out[name]
+
+    # hub/compression dimensions on the SAME workload: python hub (the
+    # round-5 leg, baseline continuity), the C++ hub, int8 error-feedback
+    # commits, and AEASGD.  Individually fallible (the native .so may be
+    # absent on a dev box) — a failed leg records its error, not the axe
+    for name, cls, extra in (
+            ("async_adag", AsyncADAG, {}),
+            ("async_adag_native", AsyncADAG, {"native_ps": True}),
+            ("async_adag_int8", AsyncADAG, {"compress_commits": "int8"}),
+            ("async_aeasgd", AsyncAEASGD, {"rho": 2.0})):
+        try:
+            async_leg(name, cls, extra)
+        except Exception as ex:
+            out[name] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    # weak-scaling points (per-worker data constant): does adding workers
+    # add throughput, or does the shared hub/relay serialize them?  The
+    # `workers`-worker point is the async_adag leg above; only the other
+    # counts run here
+    out["scaling"] = {}
+    if isinstance(out.get("async_adag"), dict) and "error" not in out["async_adag"]:
+        out["scaling"][str(workers)] = {
+            "samples_per_sec": out["async_adag"]["samples_per_sec"],
+            "per_window_wall_ms": out["async_adag"]["per_window_wall_ms"]}
+    for w in scaling_workers:
+        if w == workers:
+            continue
+        try:
+            n_w, ds_w = make_ds(w)
+            leg = async_leg(f"async_adag_w{w}", AsyncADAG, {}, w=w,
+                            leg_ds=ds_w, leg_samples=n_w * epochs)
+            out["scaling"][str(w)] = {
+                "samples_per_sec": leg["samples_per_sec"],
+                "per_window_wall_ms": leg["per_window_wall_ms"]}
+        except Exception as ex:
+            out["scaling"][str(w)] = {"error": f"{type(ex).__name__}: {ex}"}
 
     # sync denominator: the SAME update family (ADAG) through the compiled
     # window engine on the same data and epoch count — one device here, so
@@ -1121,8 +1369,9 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
     out["sync_adag"] = {"samples_per_sec": round(samples / wall, 1),
                         "wall_s": round(wall, 3),
                         "device_share": round(dev_ms / 1e3 / wall, 4)}
-    out["adag_vs_sync"] = round(out["async_adag"]["samples_per_sec"]
-                                / out["sync_adag"]["samples_per_sec"], 4)
+    if isinstance(out.get("async_adag"), dict) and "error" not in out["async_adag"]:
+        out["adag_vs_sync"] = round(out["async_adag"]["samples_per_sec"]
+                                    / out["sync_adag"]["samples_per_sec"], 4)
     return out
 
 
@@ -1169,7 +1418,12 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
         if r is not None:
             leg["vs_baseline"] = r
     moe = out.get("moe", {})
-    for mode in ("top1", "top2"):
+    # the bare top1/top2 keys carry the DEFAULT dispatch path (sorted as
+    # of round 6; dense before) — so the first sorted capture ratios
+    # against the round-5 dense record and SHOWS the dispatch-tax removal
+    # as vs_baseline > 1, after which the record advances.  The *_dense
+    # legs get their own keys so the A/B baseline persists independently
+    for mode in ("top1", "top2", "top1_dense", "top2_dense"):
         sub = moe.get(mode)
         if isinstance(sub, dict) and sub.get("timing") == "device":
             key = (f"moe:{mode}:b{moe.get('batch')}s{moe.get('seq_len')}"
@@ -1183,7 +1437,8 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     # on per-window DEVICE time, which is tenancy-stable; ms ratio inverted
     # so > 1 still means faster
     asy = out.get("async", {})
-    for mode in ("async_adag", "async_aeasgd"):
+    for mode in ("async_adag", "async_aeasgd", "async_adag_native",
+                 "async_adag_int8"):
         sub = asy.get(mode)
         if isinstance(sub, dict):
             key = (f"async:{mode}:w{asy.get('workers')}x{asy.get('window')}"

@@ -19,6 +19,30 @@ import numpy as np
 from distkeras_tpu import observability as obs
 from distkeras_tpu import utils
 
+# Out-of-core chunk-size budget (bytes of feature data per chunk) for the
+# double-buffered feed.  Promoted from bench.py's ``feed`` chunk_mb sweep
+# (12/25/49/98 MB legs, ``best_chunk_mb``): the bench re-runs the sweep
+# every capture and uses its own best for the headline comparison, so a
+# platform where a different size wins shows up as a recorded number —
+# re-promote this constant when the sweep moves.  25 MB balances transfer
+# granularity (enough batches per chunk to amortize the per-transfer
+# relay latency) against double-buffer residency (2 chunks in flight).
+DEFAULT_CHUNK_BUDGET_BYTES = 25 * 2**20
+
+
+def chunk_windows_for_budget(row_bytes: int, batch_size: int, window: int = 1,
+                             budget_bytes: Optional[int] = None) -> int:
+    """``chunk_windows`` value sizing each chunk near the feed budget.
+
+    ``row_bytes`` is one sample's feature bytes (``features[0].nbytes``).
+    Returns at least 1 (a single window may exceed the budget; chunking
+    cannot split below one window)."""
+    if row_bytes <= 0 or batch_size <= 0 or window <= 0:
+        raise ValueError(f"row_bytes, batch_size and window must be positive, "
+                         f"got {row_bytes}, {batch_size}, {window}")
+    budget = DEFAULT_CHUNK_BUDGET_BYTES if budget_bytes is None else budget_bytes
+    return max(1, budget // (row_bytes * batch_size * window))
+
 
 def prefetch_to_device(chunks: Iterator, place: Callable,
                        produce_ahead: bool = True,

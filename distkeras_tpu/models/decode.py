@@ -36,6 +36,7 @@ Implementation notes:
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -361,6 +362,34 @@ def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int,
     return jax.random.categorical(rng, logits / temperature, axis=-1).astype(jnp.int32)
 
 
+def warn_quantized_cache_gqa(config: dict, context: str) -> None:
+    """Warn when ``quantize_cache=True`` composes with GQA — a measured
+    NET LOSS, not a neutral default.
+
+    The int8 KV cache pays a quantize-on-write op per step to halve cache
+    READ traffic; GQA (``num_kv_heads < num_heads``) has already cut that
+    traffic by the head ratio, so there is little bandwidth left to win
+    and the write cost dominates: v5e b64 batched decode measured
+    **94.9k -> 82.4k tok/s (-13%)** when int8 was stacked on a 4x-GQA
+    cache (BENCH_r05 gqa_b64; BASELINE.md round 5 "int8 atop GQA is a
+    measured net loss").  The combination composes silently in config, so
+    every decode builder routes through this guard; it stays a WARNING
+    (not a refusal) because the crossover may return at much longer
+    cache_len — re-measure at your shape before suppressing it."""
+    kv_heads = config.get("num_kv_heads") or config["num_heads"]
+    if kv_heads < config["num_heads"]:
+        warnings.warn(
+            f"quantize_cache=True with GQA (num_kv_heads={kv_heads} < "
+            f"num_heads={config['num_heads']}) in {context} is a measured "
+            "net loss on v5e batched decode (94.9k -> 82.4k tok/s at "
+            "batch 64, -13%): GQA already cut the cache reads by the head "
+            "ratio, so int8's read savings no longer cover its "
+            "quantize-on-write cost.  Drop quantize_cache (keep GQA), or "
+            "re-measure at your shape (bench.py decode legs fp_b64_gqa vs "
+            "kv_int8_b64_gqa) before relying on this combination.",
+            UserWarning, stacklevel=3)
+
+
 def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
                      temperature: float = 0.0, top_k: int = 0,
                      top_p: float = 0.0,
@@ -406,6 +435,8 @@ def make_generate_fn(spec: ModelSpec, max_new_tokens: int, *,
         raise ValueError("quantize_cache requires the XLA step: the fused "
                          "kernel's slabs are bf16 (step_impl='xla' or None)")
     config = validate_decode_spec(spec, "decoding")
+    if quantize_cache:
+        warn_quantized_cache_gqa(config, "make_generate_fn")
     if not 0 <= top_k <= config["vocab_size"]:
         raise ValueError(f"top_k must be in [0, vocab_size="
                          f"{config['vocab_size']}], got {top_k} "

@@ -189,6 +189,13 @@ def make_speculative_generate_fn(target_spec: ModelSpec, draft_spec: ModelSpec,
         raise ValueError("quantize_cache requires the XLA draft step: the "
                          "fused kernel's slabs are bf16 (draft_step_impl="
                          "'xla' or None)")
+    if quantize_cache:
+        from distkeras_tpu.models.decode import warn_quantized_cache_gqa
+
+        # both caches quantize; warn per model so the message names which
+        # spec carries the GQA config (the draft rarely does)
+        warn_quantized_cache_gqa(t_cfg, "make_speculative_generate_fn (target)")
+        warn_quantized_cache_gqa(d_cfg, "make_speculative_generate_fn (draft)")
 
     sampling = temperature > 0.0
 

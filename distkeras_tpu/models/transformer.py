@@ -68,6 +68,8 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 1         # is per-expert slots per shard; top_k 1=
     ep_axis: Optional[str] = None   # Switch, 2 = GShard-style gating
     ep_size: int = 1
+    moe_dispatch: str = "auto"  # "dense" | "sorted" | "auto" dispatch path
+                                # (parallel/moe.py resolve_dispatch_impl)
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -134,6 +136,7 @@ class TransformerBlock(nn.Module):
                 capacity=cap,
                 ep_axis=self.ep_axis, ep_size=self.ep_size,
                 router_top_k=self.moe_top_k,
+                dispatch_impl=self.moe_dispatch,
                 compute_dtype=self.compute_dtype, name="moe")(y.reshape(b * l, e))
             self.sow("aux_loss", "load_balance", aux)
             return x + moe_out.reshape(b, l, e)
@@ -182,6 +185,7 @@ class TransformerLM(nn.Module):
                                # expert; imbalanced routing beyond that
                                # still drops tokens to the residual path)
     moe_top_k: int = 1         # 1 = Switch routing, 2 = GShard-style top-2
+    moe_dispatch: str = "auto"  # dispatch path: "dense" | "sorted" | "auto"
     ep_axis: Optional[str] = None
     ep_size: int = 1
     compute_dtype: jnp.dtype = jnp.bfloat16
@@ -210,6 +214,7 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts,
                 moe_capacity=self.moe_capacity,
                 moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
                 ep_axis=self.ep_axis,
                 ep_size=self.ep_size,
                 positional=self.positional,
@@ -269,7 +274,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
                   num_layers: int = 4, max_seq_len: int = 512, seq_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None, remat: bool = False,
                   moe_experts: int = 0, moe_capacity: int = 0,
-                  moe_top_k: int = 1,
+                  moe_top_k: int = 1, moe_dispatch: str = "auto",
                   num_kv_heads: Optional[int] = None,
                   positional: str = "learned",
                   attn_impl: Optional[str] = None):
@@ -295,6 +300,7 @@ def small_lm_spec(vocab_size: int = 1024, model_dim: int = 256, num_heads: int =
             "moe_experts": moe_experts,
             "moe_capacity": moe_capacity,
             "moe_top_k": moe_top_k,
+            "moe_dispatch": moe_dispatch,
             # None = auto-select per ops.attention.attention (flash on TPU
             # at L >= 2048, device-time validated across head_dim 64/128);
             # "flash"/"dense" pin the kernel for A/B measurement

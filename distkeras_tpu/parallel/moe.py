@@ -9,9 +9,21 @@ built for the MXU and ICI:
   2 = GShard-style gating with renormalized pair weights and rank
   priority — every token's first choice seats before any second
   choice).  Each expert accepts at most ``capacity`` tokens per shard
-  (the rest fall through on the residual path).  Everything is dense
-  one-hot einsums over static shapes — no gather/scatter, no dynamic
-  shapes, so XLA tiles all of it onto the MXU.
+  (the rest fall through on the residual path).
+- **Two dispatch implementations, one seating rule**
+  (``dispatch_impl``): ``"dense"`` builds the classic [T, E, C] one-hot
+  dispatch/combine tensors and einsums through them — no gathers, no
+  dynamic shapes, everything MXU-tiled, but the einsums cost
+  ``4·T·E·C·D`` matmul FLOPs of pure routing plumbing per layer (41% of
+  ALL matmul work at the round-5 bench shape).  ``"sorted"`` computes
+  the SAME seating (expert id + queue position per assignment) and then
+  moves rows by index: a static-shape scatter builds the slot->token
+  map, one gather fills the [E, C, D] slot tensor, one gather + a
+  k-term weighted sum combines — zero dispatch matmuls, O((kT + EC)·D)
+  memory traffic, still static shapes for XLA.  Both paths produce
+  bit-identical outputs (parity-tested); ``"auto"`` picks dense only
+  below a small-shape threshold where a single fused einsum beats
+  gather launch overhead (see :func:`resolve_dispatch_impl`).
 - **Experts live sharded over ``ep``.**  Dispatch is two
   ``lax.all_to_all``s over the mesh axis: token slots [E, C, D] travel to
   the shard owning their expert, come back as expert outputs — the
@@ -40,6 +52,44 @@ from distkeras_tpu.models.base import ModelSpec, register_model
 
 import flax.linen as nn
 
+# auto dispatch threshold: below this many [T, E, C] one-hot elements the
+# dense einsum pair is a single fused MXU kernel over <= 1 MB of f32 and
+# beats the sorted path's scatter+gather launch overhead; above it the
+# dense tax grows as 4·T·E·C·D matmul FLOPs (41% of ALL matmul work at
+# the round-5 bench shape T=2048, E=8, C=512) while sorted stays
+# O((kT + EC)·D) bytes moved.  The bench's dense-vs-sorted A/B legs
+# record the real crossover so drift after an XLA change trips visibly.
+_DENSE_DISPATCH_MAX_TEC = 1 << 18
+
+
+def resolve_dispatch_impl(impl: str, t: int, e: int, c: int) -> str:
+    """Resolve ``dispatch_impl`` ("dense" | "sorted" | "auto") for a
+    routing shape: tokens ``t``, experts ``e``, per-expert capacity ``c``.
+
+    ``auto`` keys on the dense one-hot tensor size ``t*e*c`` — the
+    quantity whose growth makes the dense einsums' 2·T MACs per slot
+    element intolerable — with the threshold documented above."""
+    if impl in ("dense", "sorted"):
+        return impl
+    if impl != "auto":
+        raise ValueError(f"dispatch_impl must be 'dense', 'sorted' or "
+                         f"'auto', got {impl!r}")
+    return "dense" if t * e * c <= _DENSE_DISPATCH_MAX_TEC else "sorted"
+
+
+def dispatch_matmul_flops(t: int, e: int, c: int, d: int, impl: str) -> int:
+    """FORWARD matmul FLOPs one MoE layer spends on dispatch + combine.
+
+    Dense: the [T,E,C] one-hot einsums cost ``2·T·E·C·D`` on each side.
+    Sorted: zero — rows move by gather/scatter, not contraction.  The
+    single source of truth for the bench's ``dispatch_flops_pct`` and
+    the sown per-layer stat (multiply by 3 for fwd+bwd accounting)."""
+    if impl == "sorted":
+        return 0
+    if impl != "dense":
+        raise ValueError(f"impl must be 'dense' or 'sorted', got {impl!r}")
+    return 4 * t * e * c * d
+
 
 class MoEMLP(nn.Module):
     """Router + E experts (each a 2-layer gelu MLP), top-k dispatch.
@@ -67,6 +117,9 @@ class MoEMLP(nn.Module):
     ep_axis: Optional[str] = None
     ep_size: int = 1
     router_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 gating
+    dispatch_impl: str = "auto"  # "dense" | "sorted" | "auto" — see
+                                 # resolve_dispatch_impl; same seating
+                                 # either way (bit-parity tested)
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -82,6 +135,7 @@ class MoEMLP(nn.Module):
             raise ValueError(f"router_top_k {k_r} exceeds num_experts {e}")
         if e % self.ep_size:
             raise ValueError(f"num_experts {e} not divisible by ep_size {self.ep_size}")
+        impl = resolve_dispatch_impl(self.dispatch_impl, t, e, c)
         e_local = e // self.ep_size
         router = self.param("router", nn.initializers.normal(0.02), (d, e))
         w_up_l = self.param("w_up", nn.initializers.lecun_normal(), (e_local, d, f))
@@ -101,19 +155,16 @@ class MoEMLP(nn.Module):
         # choice is seated before any token's second choice, so adding a
         # second choice never evicts someone's first.  The rank-major
         # [k*T, E] cumsum implements exactly that order; beyond-capacity
-        # assignments drop (residual path, standard Switch behavior)
+        # assignments drop (residual path, standard Switch behavior).
+        # This seating is shared by BOTH dispatch impls — parity by
+        # construction, the einsum-vs-gather choice only moves the rows
         oh_rank = jnp.swapaxes(onehots, 0, 1)                   # [k, T, E], rank-major
         rank_major = oh_rank.reshape(k_r * t, e)                # [k*T, E]
         pos_flat = jnp.cumsum(rank_major, axis=0) * rank_major - 1.0
         pos_rank = jnp.sum(pos_flat.reshape(k_r, t, e) * oh_rank,
                            axis=-1).astype(jnp.int32)           # [k, T]
         keep = pos_rank < c
-        slot = jax.nn.one_hot(jnp.where(keep, pos_rank, -1), c,
-                              dtype=jnp.float32)                # [k, T, C]; dropped -> 0
-        per_rank = oh_rank[:, :, :, None] * slot[:, :, None, :]
-        dispatch = jnp.sum(per_rank, axis=0)                    # [T, E, C]
-        combine = jnp.sum(
-            per_rank * jnp.swapaxes(gate_probs, 0, 1)[:, :, None, None], axis=0)
+        gates_rank = jnp.swapaxes(gate_probs, 0, 1)             # [k, T]
 
         # Switch load-balance aux: E * sum_e (fraction routed) * (mean prob)
         # — computed on FIRST choices for both k (the standard Switch form;
@@ -124,16 +175,52 @@ class MoEMLP(nn.Module):
 
         # router observability (surfaced by the train steps into their
         # stats output): what fraction of routed assignments fell off the
-        # capacity cliff, and how hot the hottest expert ran relative to
-        # its capacity.  Scalars, so the sow costs nothing
+        # capacity cliff, how hot the hottest expert ran relative to its
+        # capacity, and what share of this layer's matmul FLOPs the
+        # RESOLVED dispatch impl spends on routing plumbing (analytic,
+        # layer-local: dispatch over dispatch + experts + router).
+        # Scalars, so the sow costs nothing
         assigned = jnp.sum(rank_major, axis=0)                  # [E]
         self.sow("router_stats", "dropped_fraction",
-                 1.0 - jnp.sum(slot) / (k_r * t))
+                 1.0 - jnp.sum(keep.astype(jnp.float32)) / (k_r * t))
         self.sow("router_stats", "max_expert_load",
                  jnp.max(assigned) / c)
+        disp_fl = dispatch_matmul_flops(t, e, c, d, impl)
+        layer_fl = 4 * e * c * d * f + 2 * t * d * e  # experts + router, fwd
+        # NOTE the denominator: LAYER-local (dispatch + experts + router —
+        # the module cannot see attention/unembed), so under dense
+        # dispatch this reads HIGHER than the bench's same-named
+        # model-wide field (~50% vs 41% at the r05 bench shape); both are
+        # exactly 0 on the sorted path, which is the number that matters
+        self.sow("router_stats", "dispatch_flops_pct",
+                 jnp.float32(100.0 * disp_fl / (disp_fl + layer_fl)))
 
         # -- dispatch to experts ----------------------------------------------
-        slots = jnp.einsum("tec,td->ecd", dispatch.astype(self.compute_dtype), xc)
+        if impl == "dense":
+            slot = jax.nn.one_hot(jnp.where(keep, pos_rank, -1), c,
+                                  dtype=jnp.float32)            # [k, T, C]; dropped -> 0
+            per_rank = oh_rank[:, :, :, None] * slot[:, :, None, :]
+            dispatch = jnp.sum(per_rank, axis=0)                # [T, E, C]
+            slots = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(self.compute_dtype), xc)
+        else:
+            # sorted: each kept (rank, token) assignment owns a unique flat
+            # slot expert*C + queue_pos (queue positions are unique per
+            # expert across the rank-major order); dropped assignments park
+            # on a dummy slot E*C that is sliced away.  Scatter the TOKEN
+            # INDEX per slot (ints — no gradient surface), then one gather
+            # fills the slot tensor; unoccupied slots multiply to zero so
+            # the expert compute sees exactly the dense path's operand
+            choice_rank = jnp.swapaxes(choice, 0, 1)            # [k, T]
+            dest = jnp.where(keep, choice_rank * c + pos_rank, e * c)
+            flat_dest = dest.reshape(-1)                        # [k*T]
+            src_tok = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :],
+                                       (k_r, t)).reshape(-1)
+            slot_tok = jnp.zeros((e * c + 1,), jnp.int32).at[flat_dest].set(src_tok)
+            occupied = jnp.zeros((e * c + 1,), self.compute_dtype
+                                 ).at[flat_dest].set(1)
+            slots = (jnp.take(xc, slot_tok[:e * c], axis=0)
+                     * occupied[:e * c, None]).reshape(e, c, d)
         ep = 1
         if self.ep_axis is not None and self.ep_axis in jax.typeof(x).vma:
             ep = lax.axis_size(self.ep_axis)
@@ -156,7 +243,26 @@ class MoEMLP(nn.Module):
             out_slots = lax.all_to_all(out_slots, self.ep_axis, split_axis=1,
                                        concat_axis=0, tiled=True)
 
-        out = jnp.einsum("tec,ecd->td", combine.astype(self.compute_dtype), out_slots)
+        if impl == "dense":
+            combine = jnp.sum(per_rank * gates_rank[:, :, None, None], axis=0)
+            out = jnp.einsum("tec,ecd->td",
+                             combine.astype(self.compute_dtype), out_slots)
+        else:
+            # gather each assignment's expert output back by its flat slot
+            # (dropped -> the appended zero row), then gate-weight and sum
+            # over the k ranks with the same precision as the dense
+            # combine (compute-dtype operands, dot accumulation, one
+            # downcast)
+            padded = jnp.concatenate(
+                [out_slots.reshape(e * c, d),
+                 jnp.zeros((1, d), out_slots.dtype)], axis=0)
+            y_tok = jnp.take(padded, dest, axis=0)              # [k, T, D]
+            gates_c = gates_rank.astype(self.compute_dtype)     # [k, T]
+            # the k-term sum as a contraction (not an explicit mul+add):
+            # XLA lowers it through the same dot/FMA machinery as the
+            # dense combine einsum, which is what keeps the two paths
+            # bit-identical rather than 1-ulp apart under top-2
+            out = jnp.einsum("kt,ktd->td", gates_c, y_tok)
         return out.astype(x.dtype), aux
 
 
@@ -177,6 +283,7 @@ class MoEClassifier(nn.Module):
     ep_axis: Optional[str] = None
     ep_size: int = 1
     router_top_k: int = 1
+    dispatch_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -184,7 +291,8 @@ class MoEClassifier(nn.Module):
         moe_out, aux = MoEMLP(num_experts=self.num_experts, model_dim=self.model_dim,
                               hidden_dim=self.hidden_dim, capacity=self.capacity,
                               ep_axis=self.ep_axis, ep_size=self.ep_size,
-                              router_top_k=self.router_top_k, name="moe")(h)
+                              router_top_k=self.router_top_k,
+                              dispatch_impl=self.dispatch_impl, name="moe")(h)
         h = h + moe_out
         self.sow("aux_loss", "load_balance", aux)
         return nn.Dense(self.num_outputs, name="head")(h)
@@ -192,12 +300,13 @@ class MoEClassifier(nn.Module):
 
 def moe_classifier_spec(input_dim: int = 32, num_experts: int = 4, capacity: int = 64,
                         num_outputs: int = 10, ep_axis: Optional[str] = None,
-                        router_top_k: int = 1) -> ModelSpec:
+                        router_top_k: int = 1,
+                        dispatch_impl: str = "auto") -> ModelSpec:
     return ModelSpec(
         name="moe_mlp_classifier",
         config={"input_dim": input_dim, "num_experts": num_experts,
                 "capacity": capacity, "num_outputs": num_outputs, "ep_axis": ep_axis,
-                "router_top_k": router_top_k},
+                "router_top_k": router_top_k, "dispatch_impl": dispatch_impl},
         input_shape=(input_dim,),
     )
 
@@ -297,7 +406,8 @@ def _make_moe_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
             # used to discard: surfaced as gauges.  float() blocks on the
             # step — only paid when telemetry is on
             stats = out[3]
-            for stat_name in ("dropped_fraction", "max_expert_load"):
+            for stat_name in ("dropped_fraction", "max_expert_load",
+                              "dispatch_flops_pct"):
                 if stat_name in stats:
                     obs.gauge(f"moe_{stat_name}").set(float(stats[stat_name]))
             obs.counter("moe_steps_total").inc()
@@ -314,9 +424,13 @@ def make_moe_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation
     ``y`` one-hot.  Expert weights sharded over ep (place state with
     ``moe_state_shardings``), everything else replicated.  ``router_stats``
     is a dict of scalars averaged over MoE layers and shards —
-    ``dropped_fraction`` (routed assignments lost to the capacity cliff)
-    and ``max_expert_load`` (hottest expert's assignments / capacity) —
-    for the training loop's metrics.
+    ``dropped_fraction`` (routed assignments lost to the capacity cliff),
+    ``max_expert_load`` (hottest expert's assignments / capacity) and
+    ``dispatch_flops_pct`` (share of the MoE LAYER's matmul FLOPs —
+    dispatch + experts + router — spent on routing plumbing; exactly 0
+    for sorted.  The bench's same-named field divides by the whole
+    MODEL's FLOPs incl. attention and unembed, so its dense numbers run
+    lower) — for the training loop's metrics.
     """
     return _make_moe_step(
         spec, optimizer, mesh, dp_axis, ep_axis, aux_weight,

@@ -78,15 +78,19 @@ def head_recompute_factor(pp: int, num_microbatches: int) -> float:
     """1F1B's head (+CE) evaluations per step relative to GPipe's.
 
     GPipe evaluates the final-norm + unembed + softmax-CE once per
-    microbatch (M total); the 1F1B schedule's ``unit_scalar`` evaluates it
-    on every rank in every cycle (``pp`` ranks x ``M + 2(pp-1)`` cycles) —
-    the SPMD-inherent cost documented in :func:`make_pp_train_step`'s
-    ``"1f1b"`` notes.  The single definition shared by the docs, the bench
-    ``pipeline`` leg and the tests."""
+    microbatch (M total).  Since the head moved inside a ``lax.cond``
+    gated on (last rank AND valid backward unit), 1F1B evaluates it
+    exactly M times too — factor **1.0**.  The round-5 schedule's
+    ``jnp.where`` form computed-then-masked the head on every rank every
+    cycle, ``pp * (1 + 2(pp-1)/M)`` times GPipe's unembed FLOPs — the
+    measured reason it lost to GPipe at every M (1081 vs 596 ms at M=2).
+    The function stays so the bench ``pipeline`` leg keeps recording the
+    factor next to the measurement: a schedule change that reintroduces
+    head recompute must move this number, not a docstring."""
     if pp < 1 or num_microbatches < 1:
         raise ValueError(f"pp and num_microbatches must be >= 1, got "
                          f"{pp}, {num_microbatches}")
-    return pp * (1.0 + 2.0 * (pp - 1) / num_microbatches)
+    return 1.0
 
 
 def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
@@ -116,20 +120,20 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
       Pick it when M must grow (long sequences / small microbatches)
       and GPipe's O(M) residuals would not fit HBM.
 
-      **Per-cycle head-recompute cost (SPMD-inherent, ADVICE round 5):**
-      ``unit_scalar`` evaluates the final-norm + unembed matmul and the
-      vocab-wide softmax-CE (plus an embedding vjp) on EVERY rank in
-      EVERY cycle, with the ``where``-selected result masked away on all
-      but the last (resp. first) rank — that is how the head's gradient
-      stays inside one SPMD program without a separate last-rank
-      computation.  Relative to GPipe's single head evaluation per
-      microbatch, 1F1B spends roughly ``pp * (1 + 2*(pp-1)/M)`` times
-      the unembed FLOPs (``pp`` ranks each run it for ``M + 2(pp-1)``
-      cycles vs M microbatches once).  Negligible for small vocabularies;
-      at production vocab sizes it is a real tax on top of the memory
-      win — ``bench.py``'s ``pipeline`` leg records the measured
-      gpipe-vs-1f1b step time next to this analytic
-      ``head_recompute_factor`` so the tradeoff stays a number.
+      **Head cost (fixed in round 6):** ``unit_scalar`` runs the
+      final-norm + unembed matmul and the vocab-wide softmax-CE inside a
+      ``lax.cond`` whose predicate is (last rank AND valid backward
+      unit) — XLA conditionals execute one branch per device at
+      runtime, so only the last rank's M valid units ever pay the
+      vocab-sized matmul; every other rank (and fill/drain cycles) runs
+      the cheap cotangent chain term.  ``head_recompute_factor`` is
+      therefore 1.0 — the same head FLOPs as GPipe.  (The round-5 form
+      computed the head on every rank every cycle and masked it with
+      ``jnp.where`` — ``pp * (1 + 2(pp-1)/M)`` times GPipe's unembed
+      FLOPs, the measured reason 1F1B lost to GPipe at every M.)
+      ``bench.py``'s ``pipeline`` leg records the measured
+      gpipe-vs-1f1b step time next to the analytic factor so a
+      regression trips as a number, not a docstring drift.
     """
     if spec.config.get("moe_experts"):
         raise ValueError("MoE FFN does not compose with pipeline parallelism "
@@ -183,9 +187,10 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
         ``b + 2(pp-1) - r``, span <= 2(pp-1) < ring), and parameter
         gradients accumulate explicitly.  The last rank's backward unit
         folds the head + CE vjp into the same grad call via a
-        ``where``-selected scalar (gradient of ``where`` masks each
-        branch, so non-last ranks contribute exactly the cotangent
-        chain and zero head gradient).
+        ``lax.cond``-selected scalar (the cond's vjp is the cond of the
+        branch vjps, so non-head units contribute exactly the cotangent
+        chain and zero head gradient — and, unlike the round-5
+        ``jnp.where`` form, never EXECUTE the vocab-sized head matmul).
 
         Resident activations really are O(pp): the embedding runs PER
         CYCLE on the current microbatch's tokens (the full-epoch token
@@ -218,14 +223,31 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
             return module.apply({"params": outer_}, tok_1mb,
                                 method="embed_tokens")
 
-        def unit_scalar(blocks_, outer_, x_in, cot_in, tgt_1mb, last_flag):
+        def unit_scalar(blocks_, outer_, x_in, cot_in, tgt_1mb, head_flag):
+            """``head_flag`` = (last rank AND valid backward unit): the
+            vocab-sized head + CE runs inside a ``lax.cond`` branch, so
+            every other rank (and the last rank's fill/drain cycles)
+            executes only the cheap chain term at RUNTIME — XLA
+            conditionals evaluate one branch per device, which is how a
+            per-rank branch lives inside one SPMD program without every
+            rank paying the unembed matmul (the round-5 ``jnp.where``
+            form computed-then-masked it: pp ranks x every cycle of
+            vocab-sized waste, the reason 1F1B lost to GPipe at every
+            measured M).  Autodiff through cond yields the cond of the
+            branch vjps, so non-head units contribute exactly the
+            cotangent chain and zero head gradient, as before."""
             y = stage_apply(blocks_, x_in)
-            logits = module.apply({"params": outer_}, y, method="head")
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), tgt_1mb.astype(jnp.int32))
-            ce_term = jnp.sum(ce[:, :-1])
-            chain_term = jnp.sum((y * cot_in).astype(jnp.float32))
-            return jnp.where(last_flag, ce_term, chain_term)
+
+            def ce_term(y_):
+                logits = module.apply({"params": outer_}, y_, method="head")
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), tgt_1mb.astype(jnp.int32))
+                return jnp.sum(ce[:, :-1])
+
+            def chain_term(y_):
+                return jnp.sum((y_ * cot_in).astype(jnp.float32))
+
+            return lax.cond(head_flag, ce_term, chain_term, y)
 
         unit_grad = jax.value_and_grad(unit_scalar, argnums=(0, 1, 2))
 
@@ -258,8 +280,13 @@ def make_pp_train_step(spec: ModelSpec, optimizer: optax.GradientTransformation,
                 acts, jnp.clip(stored_at, 0, cycles) % ring, 0, keepdims=False)
             tgt_b = lax.dynamic_index_in_dim(tgt_mb, jnp.clip(b_idx, 0, m - 1),
                                              0, keepdims=False)
+            # head branch only where it counts: the last rank's VALID
+            # units (b_valid also gates it so fill/drain cycles skip the
+            # unembed too — the head now runs exactly M times per step,
+            # matching GPipe's count)
             val, (gb, go, gx) = unit_grad(blocks_v, outer_v, x_in_b, cot_buf,
-                                          tgt_b, is_last)
+                                          tgt_b,
+                                          jnp.logical_and(is_last, b_valid))
             mask = b_valid.astype(jnp.float32)
             # rank 0's input cotangent is the embedding cotangent for mb b:
             # fold it into the outer grads NOW (inline vjp over one

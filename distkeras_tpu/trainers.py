@@ -48,7 +48,7 @@ class Trainer:
                  momentum: Optional[float] = None,
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
-                 chunk_windows: Optional[int] = None,
+                 chunk_windows: Optional[Union[int, str]] = None,
                  profile_dir: Optional[str] = None):
         if isinstance(model, ModelSpec):
             model = Model.init(model, seed=seed)
@@ -63,8 +63,13 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = seed
         # bound host->device feeding to this many windows per transfer
-        # (None = whole epoch in one transfer, the small-data fast path)
-        self.chunk_windows = chunk_windows if chunk_windows is None else int(chunk_windows)
+        # (None = whole epoch in one transfer, the small-data fast path;
+        # "auto" = size chunks near DEFAULT_CHUNK_BUDGET_BYTES — the feed
+        # bench's promoted chunk_mb — resolved per dataset at train time)
+        if chunk_windows is None or chunk_windows == "auto":
+            self.chunk_windows = chunk_windows
+        else:
+            self.chunk_windows = int(chunk_windows)
         # observability (SURVEY §5 rows 1/5): per-epoch throughput records
         # in self.metrics; profile_dir writes a jax.profiler trace of train()
         self.profile_dir = profile_dir
@@ -72,6 +77,17 @@ class Trainer:
         self.history: List[float] = []  # per-window (or per-batch) mean loss
         self._t_start: Optional[float] = None
         self._t_end: Optional[float] = None
+
+    def _resolve_chunk_windows(self, dataset, batch_size: int, window: int):
+        """``chunk_windows`` for this dataset: passthrough unless "auto",
+        which sizes chunks near the feed budget (one row's feature bytes x
+        batch x window per window — ``chunk_windows_for_budget``)."""
+        if self.chunk_windows != "auto":
+            return self.chunk_windows
+        from distkeras_tpu.data.dataset import chunk_windows_for_budget
+
+        row_bytes = int(np.asarray(dataset[self.features_col][0]).nbytes)
+        return chunk_windows_for_budget(row_bytes, batch_size, window)
 
     # reference API: record_training_start/record_training_end/get_training_time
     def record_training_start(self) -> None:
@@ -348,7 +364,9 @@ class SingleTrainer(Trainer):
                 placed = prefetch_to_device(
                     ds.chunked_epoch(self.batch_size,
                                      [self.features_col, self.label_col],
-                                     window=1, chunk_windows=self.chunk_windows),
+                                     window=1,
+                                     chunk_windows=self._resolve_chunk_windows(
+                                         ds, self.batch_size, 1)),
                     place)
                 with obs.span("trainer.epoch", trainer=type(self).__name__,
                               epoch=epoch):
@@ -471,7 +489,9 @@ class DistributedTrainer(Trainer):
                     ds.chunked_epoch(global_batch,
                                      [self.features_col, self.label_col],
                                      window=self.communication_window,
-                                     chunk_windows=self.chunk_windows),
+                                     chunk_windows=self._resolve_chunk_windows(
+                                         ds, global_batch,
+                                         self.communication_window)),
                     lambda ch: engine.place_data(ch[self.features_col],
                                                  ch[self.label_col]))
                 with obs.span("trainer.epoch", trainer=type(self).__name__,

@@ -9,6 +9,8 @@ with an ``error`` note on failure.
 
 import json
 
+import pytest
+
 import bench
 
 
@@ -121,3 +123,92 @@ def test_lm_wall_fallback_skips_baseline():
     baseline = {"legs": {"lm:2048x8:d512h8": {"tokens_per_sec": 50.0}}}
     bench._apply_leg_baselines(out, baseline)
     assert "vs_baseline" not in out["lm"][0]
+
+
+@pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
+def test_feed_bench_sweep_and_decomposition_tiny_on_cpu():
+    """The feed leg's round-6 shape: a chunk-size sweep whose best config
+    is promoted to the headline comparison, plus a per-chunk IO/wire/step
+    decomposition — all at toy scale."""
+    out = bench._bench_feed(batch=16, total_batches=8, reps=1,
+                            sweep_batches_per_chunk=(2, 4), sweep_reps=1)
+    assert len(out["sweep"]) == 2
+    assert {"batches_per_chunk", "chunk_mb", "prefetch_ms",
+            "samples_per_sec"} <= set(out["sweep"][0])
+    assert out["best_chunk_mb"] in {s["chunk_mb"] for s in out["sweep"]}
+    # the headline comparison ran AT the promoted best size
+    assert out["chunk_mb"] == out["best_chunk_mb"]
+    dec = out["decomposition"]
+    for k in ("io_ms_per_chunk", "wire_ms_per_chunk",
+              "step_wall_ms_per_chunk", "device_ms_per_chunk"):
+        assert dec[k] >= 0.0, k
+    assert out["compute_only_ms"] > 0 and out["prefetch_ms"] > 0
+
+
+@pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
+def test_moe_capacity_sweep_tiny_on_cpu():
+    """Trained-router capacity sweep machinery at toy scale: drops are
+    recorded untrained AND trained per factor, and training reduces them
+    at generous capacity (the aux loss is in the objective)."""
+    sweep = bench._bench_moe_capacity_sweep(
+        model_dim=16, num_heads=2, vocab=64, experts=4, batch=2, seq_len=16,
+        num_layers=1, steps=40, factors=(1.0, 2.0))
+    import numpy as np
+
+    assert [s["capacity_factor"] for s in sweep] == [1.0, 2.0]
+    for s in sweep:
+        assert 0.0 <= s["dropped_fraction_trained"] <= 1.0
+        assert 0.0 <= s["dropped_fraction_untrained"] <= 1.0
+        assert s["capacity"] >= 1 and np.isfinite(s["final_loss"])
+
+
+def test_moe_baseline_keys_cover_dispatch_legs():
+    """top1 (sorted, default) and top1_dense ratio against SEPARATE
+    baseline records; a wall-fallback leg must not ratio at all."""
+    moe = {"batch": 4, "seq_len": 512, "experts": 8,
+           "top1": {"timing": "device", "tokens_per_sec": 400.0},
+           "top1_dense": {"timing": "device", "tokens_per_sec": 250.0},
+           "top2": {"timing": "wall", "tokens_per_sec": 300.0}}
+    baseline = {"legs": {
+        "moe:top1:b4s512e8:device": {"tokens_per_sec": 253.2},
+        "moe:top1_dense:b4s512e8:device": {"tokens_per_sec": 250.0}}}
+    out = {"moe": moe}
+    bench._apply_leg_baselines(out, baseline)
+    assert moe["top1"]["vs_baseline"] == round(400.0 / 253.2, 4)
+    assert moe["top1_dense"]["vs_baseline"] == 1.0
+    assert "vs_baseline" not in moe["top2"]  # wall fallback
+
+
+def test_async_baseline_keys_cover_new_legs():
+    asy = {"workers": 2, "window": 8, "batch": 256,
+           "async_adag_native": {"per_window_device_ms": 2.0},
+           "async_adag_int8": {"per_window_device_ms": 4.0}}
+    baseline = {"legs": {
+        "async:async_adag_native:w2x8b256:device-window":
+            {"per_window_device_ms": 4.0}}}
+    out = {"async": asy}
+    bench._apply_leg_baselines(out, baseline)
+    assert asy["async_adag_native"]["vs_baseline"] == 2.0  # ms inverted
+    assert "vs_baseline" not in asy["async_adag_int8"]  # no record yet
+
+
+@pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
+def test_moe_acceptance_block_shape():
+    """The issue-2 tripwire block: booleans (or None off-TPU) with the
+    targets recorded next to them, derived from top1 + the sweep."""
+    import numpy as _np
+    if not hasattr(__import__("jax"), "shard_map"):
+        import pytest
+        pytest.skip("jax.shard_map unavailable (moe perf legs need it)")
+    out = bench._bench_moe(batch=1, seq_len=16, model_dim=16, num_heads=2,
+                           num_layers=1, vocab=64, experts=4, reps=1,
+                           sweep_layers=1, sweep_steps=8,
+                           capacity_factors=(2.0,))
+    acc = out["acceptance"]
+    assert acc["mfu_target"] == 0.45 and acc["dispatch_pct_target"] == 20.0
+    assert acc["trained_drop_target"] == 0.05
+    assert acc["dispatch_pct_ok"] is True  # sorted path: 0% dispatch FLOPs
+    assert out["top1"]["dispatch_impl"] == "sorted"
+    assert out["top1_dense"]["dispatch_impl"] == "dense"
+    assert out["top1_dense"]["dispatch_flops_pct"] > 0
+    assert _np.isfinite(out["sorted_vs_dense_top1"])

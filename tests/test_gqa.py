@@ -106,6 +106,33 @@ def test_quantized_cache_gqa(gqa_model):
     np.testing.assert_array_equal(got, want)
 
 
+def test_quantized_cache_gqa_warns_net_loss(gqa_model):
+    """int8 KV x GQA is a measured 13% net loss (94.9k -> 82.4k tok/s at
+    b64, BASELINE.md round 5) that composes silently in config — every
+    decode builder must emit the documented warning, and must NOT emit it
+    for int8-on-MHA or GQA-without-int8 (issue 2 satellite)."""
+    import warnings as _warnings
+
+    from distkeras_tpu.models.speculative import make_speculative_generate_fn
+
+    with pytest.warns(UserWarning, match="measured net loss"):
+        make_generate_fn(gqa_model.spec, 4, quantize_cache=True)
+    # speculative builder routes through the same guard (GQA target)
+    draft = Model.init(small_lm_spec(vocab_size=VOCAB, model_dim=D,
+                                     num_heads=2, num_layers=1,
+                                     max_seq_len=48), seed=9)
+    with pytest.warns(UserWarning, match="measured net loss"):
+        make_speculative_generate_fn(gqa_model.spec, draft.spec, 4, k=2,
+                                     quantize_cache=True)
+    # no warning when the trap is absent: MHA + int8, and GQA without int8
+    mha = small_lm_spec(vocab_size=VOCAB, model_dim=D, num_heads=H,
+                        num_layers=LAYERS, max_seq_len=48)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        make_generate_fn(mha, 4, quantize_cache=True)
+        make_generate_fn(gqa_model.spec, 4)
+
+
 def test_beam_and_speculative_match_mha_twin(gqa_model):
     """The rest of the serving family rides the same cache math: beam
     search scores and speculative commits equal the MHA twin's."""

@@ -266,9 +266,14 @@ def test_prefetch_raises_when_producer_dies_without_sentinel(monkeypatch):
 def test_head_recompute_factor_formula():
     from distkeras_tpu.parallel.pipeline import head_recompute_factor
 
-    assert head_recompute_factor(1, 8) == 1.0  # no pipeline, no overhead
-    assert head_recompute_factor(2, 8) == pytest.approx(2 * (1 + 2 / 8))
-    assert head_recompute_factor(4, 8) == pytest.approx(4 * (1 + 6 / 8))
+    # round 6: the 1F1B head + CE runs in a lax.cond taken only on the
+    # last rank's valid backward units — exactly M evaluations per step,
+    # same as GPipe, so the factor is 1.0 at EVERY (pp, M).  The round-5
+    # where-masked schedule measured pp * (1 + 2(pp-1)/M); if this
+    # assertion ever needs a formula again, head recompute came back
+    assert head_recompute_factor(1, 8) == 1.0
+    assert head_recompute_factor(2, 8) == 1.0
+    assert head_recompute_factor(4, 8) == 1.0
     with pytest.raises(ValueError):
         head_recompute_factor(0, 8)
 
