@@ -1321,15 +1321,76 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             "per_window_device_ms": round(dev_ms / max(n_windows, 1), 2),
             "hub": "native" if extra.get("native_ps") else "python",
             "compress": extra.get("compress_commits"),
+            "transport": extra.get("transport", "socket"),
+            "pipeline": extra.get("pipeline", True),
+            # final-loss parity evidence: pipelined pulls see the center one
+            # commit earlier (self-staleness 1), so the issue-3 acceptance
+            # records where every leg's trajectory LANDS, not just its speed
+            "final_loss": (round(float(np.mean(tr.history[-8:])), 6)
+                           if tr.history else None),
         }
         return out[name]
 
-    # hub/compression dimensions on the SAME workload: python hub (the
-    # round-5 leg, baseline continuity), the C++ hub, int8 error-feedback
-    # commits, and AEASGD.  Individually fallible (the native .so may be
-    # absent on a dev box) — a failed leg records its error, not the axe
+    def decomposition_leg(name, cls, extra):
+        """Instrumented re-run of a leg (telemetry ON — its own wall clock,
+        NOT comparable to the timed leg): the wall/wire/serialize/device
+        split plus the hub's staleness distribution, per transport —
+        issue-3's evidence that the relay/transport tax actually moved."""
+        from distkeras_tpu import observability as obs
+
+        tr = cls(Model.init(spec, seed=0), num_workers=workers,
+                 communication_window=window, **dict(kwargs, **extra))
+        tr.train(ds, shuffle=False)  # compile + warm
+        tr.model = Model.init(spec, seed=0)
+        tr.history = []
+        obs.enable()
+        obs.reset()
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                with jax.profiler.trace(td):
+                    t0 = time.perf_counter()
+                    tr.train(ds, shuffle=False)
+                    wall_ms = (time.perf_counter() - t0) * 1e3
+                dev_ms = sum(_trace_jit_durs(td))
+            snap = obs.snapshot()
+        finally:
+            obs.reset()
+            obs.disable()
+        hists = snap.get("histograms", {})
+
+        def hsum(key):
+            return float((hists.get(key) or {}).get("sum") or 0.0)
+
+        n_windows = max(len(tr.history), 1)
+        staleness = hists.get("ps_commit_staleness") or {}
+        out[name]["decomposition"] = {
+            "timing": "instrumented-wall",
+            "wall_ms": round(wall_ms, 1),
+            "device_ms": round(dev_ms, 1),
+            # wire = time workers actually BLOCKED on the exchange after
+            # overlap (pull stalls); serialize = frame pack time; the
+            # remainder is dispatch + feed + Python loop
+            "wire_stall_ms": round(hsum("ps.pull_stall_ms"), 1),
+            "serialize_ms": round(hsum("ps.serialize_ms"), 3),
+            "commit_wire_bytes": snap.get("counters", {}).get("ps.commit_bytes", 0.0),
+            "per_window_wall_ms": round(wall_ms / n_windows, 2),
+            "per_window_wire_stall_ms": round(hsum("ps.pull_stall_ms") / n_windows, 3),
+            "staleness": {"count": staleness.get("count"),
+                          "mean": staleness.get("mean"),
+                          "max": staleness.get("max"),
+                          "buckets": staleness.get("buckets")},
+        }
+
+    # transport/hub/compression dimensions on the SAME workload: python hub
+    # pipelined sockets (baseline-continuity key), the inproc transport, the
+    # serial pre-overhaul exchange (pipeline=False — the final-loss parity
+    # reference), the C++ hub, int8 error-feedback commits, and AEASGD.
+    # Individually fallible (the native .so may be absent on a dev box) — a
+    # failed leg records its error, not the axe
     for name, cls, extra in (
             ("async_adag", AsyncADAG, {}),
+            ("async_adag_inproc", AsyncADAG, {"transport": "inproc"}),
+            ("async_adag_serial", AsyncADAG, {"pipeline": False}),
             ("async_adag_native", AsyncADAG, {"native_ps": True}),
             ("async_adag_int8", AsyncADAG, {"compress_commits": "int8"}),
             ("async_aeasgd", AsyncAEASGD, {"rho": 2.0})):
@@ -1337,6 +1398,15 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             async_leg(name, cls, extra)
         except Exception as ex:
             out[name] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    # per-transport decomposition (socket vs inproc), on the headline config
+    for name, extra in (("async_adag", {}),
+                        ("async_adag_inproc", {"transport": "inproc"})):
+        if isinstance(out.get(name), dict) and "error" not in out[name]:
+            try:
+                decomposition_leg(name, AsyncADAG, extra)
+            except Exception as ex:
+                out[name]["decomposition"] = {"error": f"{type(ex).__name__}: {ex}"}
 
     # weak-scaling points (per-worker data constant): does adding workers
     # add throughput, or does the shared hub/relay serialize them?  The
@@ -1363,16 +1433,65 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
     # sync denominator: the SAME update family (ADAG) through the compiled
     # window engine on the same data and epoch count — one device here, so
     # this is the single-chip sync path the async mode competes with
-    sync = ADAG(Model.init(spec, seed=0), num_workers=1,
-                communication_window=window, **kwargs)
-    wall, dev_ms = timed_run(sync)
-    out["sync_adag"] = {"samples_per_sec": round(samples / wall, 1),
-                        "wall_s": round(wall, 3),
-                        "device_share": round(dev_ms / 1e3 / wall, 4)}
-    if isinstance(out.get("async_adag"), dict) and "error" not in out["async_adag"]:
+    try:
+        sync = ADAG(Model.init(spec, seed=0), num_workers=1,
+                    communication_window=window, **kwargs)
+        wall, dev_ms = timed_run(sync)
+        out["sync_adag"] = {"samples_per_sec": round(samples / wall, 1),
+                            "wall_s": round(wall, 3),
+                            "device_share": round(dev_ms / 1e3 / wall, 4)}
+    except Exception as ex:
+        # a dead sync denominator (e.g. no jax.shard_map in the env) must
+        # not axe the async legs and their decomposition evidence — the
+        # ratios below just come back absent
+        out["sync_adag"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    _async_acceptance(out)
+    return out
+
+
+def _async_acceptance(out: dict) -> None:
+    """Attach the issue-3 ratios + acceptance tripwires to an async-section
+    dict, in place.  Booleans (or None when a leg is missing/errored) so a
+    transport regression trips visibly in the punchcard instead of hiding
+    in a ratio nobody reads.  The r05 reference (BENCH_r05.json
+    async_adag: per_window_wall_ms 421.15, adag_vs_sync 0.5186) is the
+    pre-overhaul relay-bound hot path this change exists to fix."""
+    def _ok(name):
+        return isinstance(out.get(name), dict) and "error" not in out[name]
+
+    if _ok("async_adag") and _ok("sync_adag"):
         out["adag_vs_sync"] = round(out["async_adag"]["samples_per_sec"]
                                     / out["sync_adag"]["samples_per_sec"], 4)
-    return out
+    if _ok("async_adag_inproc") and _ok("sync_adag"):
+        out["adag_inproc_vs_sync"] = round(
+            out["async_adag_inproc"]["samples_per_sec"]
+            / out["sync_adag"]["samples_per_sec"], 4)
+
+    r05_wall_ms = 421.15
+    speedup = (round(r05_wall_ms / out["async_adag"]["per_window_wall_ms"], 2)
+               if _ok("async_adag") else None)
+    parity = None
+    if _ok("async_adag") and _ok("async_adag_serial"):
+        fl_p = out["async_adag"]["final_loss"]
+        fl_s = out["async_adag_serial"]["final_loss"]
+        parity = {"pipelined": fl_p, "serial": fl_s,
+                  "abs_diff": (None if fl_p is None or fl_s is None
+                               else round(abs(fl_p - fl_s), 6))}
+    out["acceptance"] = {
+        "adag_vs_sync_target": 0.85,
+        "adag_vs_sync_ok": (bool(out["adag_vs_sync"] >= 0.85)
+                            if "adag_vs_sync" in out else None),
+        "inproc_vs_sync_target": 0.95,
+        "inproc_vs_sync_ok": (bool(out["adag_inproc_vs_sync"] >= 0.95)
+                              if "adag_inproc_vs_sync" in out else None),
+        "r05_per_window_wall_ms": r05_wall_ms,
+        "per_window_speedup_vs_r05": speedup,
+        "per_window_speedup_target": 5.0,
+        "per_window_speedup_ok": (None if speedup is None
+                                  else bool(speedup >= 5.0)),
+        "final_loss_parity": parity,
+    }
 
 
 def _leg_ratio(current: float, base: float):
@@ -1438,7 +1557,7 @@ def _apply_leg_baselines(out: dict, baseline: dict) -> None:
     # so > 1 still means faster
     asy = out.get("async", {})
     for mode in ("async_adag", "async_aeasgd", "async_adag_native",
-                 "async_adag_int8"):
+                 "async_adag_int8", "async_adag_inproc", "async_adag_serial"):
         sub = asy.get(mode)
         if isinstance(sub, dict):
             key = (f"async:{mode}:w{asy.get('workers')}x{asy.get('window')}"

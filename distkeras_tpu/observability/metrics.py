@@ -58,6 +58,18 @@ def _render_name(name: str, labels: _LabelKey) -> str:
     return f"{name}{{{inner}}}"
 
 
+def _prometheus_name(name: str) -> str:
+    """Map a registry name onto the Prometheus metric-name grammar
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots etc. become underscores)."""
+    sanitized = "".join(c if (c.isascii() and (c.isalnum() or c in "_:"))
+                        else "_" for c in name)
+    if not sanitized or not (sanitized[0].isascii()
+                             and (sanitized[0].isalpha()
+                                  or sanitized[0] in "_:")):
+        sanitized = "_" + sanitized
+    return sanitized
+
+
 class Counter:
     """Monotonic counter.  ``inc`` is a no-op while the owning registry is
     disabled."""
@@ -286,15 +298,20 @@ class MetricsRegistry:
         """Prometheus text exposition format 0.0.4, rendered on demand —
         the pull-style sink (no server here; the punchcard daemon's
         ``telemetry`` action and any embedding HTTP handler just return
-        this string)."""
+        this string).  Registry names may contain characters the
+        Prometheus grammar forbids (the client-side PS instruments are
+        dotted, e.g. ``ps.pull_latency_ms``); they are sanitized to
+        underscores HERE only — snapshots and the punchcard JSON keep the
+        registry spelling."""
         by_name: Dict[str, List[object]] = {}
         for inst in self.instruments():
             by_name.setdefault(inst.name, []).append(inst)
         lines: List[str] = []
-        for name in sorted(by_name):
-            kind = self._kinds[name]
+        for raw in sorted(by_name):
+            kind = self._kinds[raw]
+            name = _prometheus_name(raw)
             lines.append(f"# TYPE {name} {kind}")
-            for inst in sorted(by_name[name], key=lambda i: i.labels):
+            for inst in sorted(by_name[raw], key=lambda i: i.labels):
                 if isinstance(inst, Histogram):
                     s = inst.summary()
                     cum = 0

@@ -17,9 +17,12 @@ Two interchangeable parameter-server hubs speak one wire protocol:
 """
 
 from distkeras_tpu.runtime.networking import (  # noqa: F401
+    FlatFrameCodec,
+    configure_socket,
     connect,
     determine_host_address,
     recv_frame,
+    recv_frame_into,
     recv_json,
     recv_tensors,
     send_frame,
@@ -30,6 +33,7 @@ from distkeras_tpu.runtime.parameter_server import (  # noqa: F401
     ADAGParameterServer,
     DeltaParameterServer,
     DynSGDParameterServer,
+    InprocPSClient,
     PSClient,
     SocketParameterServer,
 )

@@ -17,7 +17,17 @@ Differences from the reference's execution (same semantics, new substrate):
   at window boundaries — exactly where the socket exchange happens anyway;
 - the PS hub may be the C++ one (``native/ps_server.cpp``) — commits then
   apply outside the GIL, so in-process worker threads genuinely overlap;
-- weights travel as raw float32 frames, not pickles.
+- weights travel as raw float32 frames, not pickles — through the
+  zero-copy flat framing path (one preallocated frame per direction,
+  ``recv_into`` scatter receives; ``networking.FlatFrameCodec``);
+- the exchange is PIPELINED by default (``pipeline=True``): the pull for
+  window k+1 is prefetched while window k computes and commit acks
+  coalesce into later receives, so wall-per-window converges toward
+  max(compute, wire) instead of their sum (staleness semantics:
+  ARCHITECTURE.md "Async transport");
+- co-located workers may skip sockets entirely with ``transport="inproc"``
+  (same center logic under the hub's lock, identical trajectories;
+  sockets stay the default for multi-host authenticity).
 
 Worker threads in one process share the single JAX runtime; with multiple
 devices visible each worker pins its compute to ``devices[i % n]``, giving
@@ -50,6 +60,7 @@ from distkeras_tpu.runtime.parameter_server import (
     ADAGParameterServer,
     DeltaParameterServer,
     DynSGDParameterServer,
+    InprocPSClient,
     PSClient,
     SocketParameterServer,
 )
@@ -95,11 +106,39 @@ class AsyncDistributedTrainer(Trainer):
                  on_worker_failure: str = "raise",
                  fault_hook: Optional[Callable[[int, int], None]] = None,
                  compress_commits: Optional[str] = None,
+                 transport: str = "socket",
+                 pipeline: bool = True,
+                 max_inflight_commits: int = 2,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.native_ps = bool(native_ps)
+        # transport="socket" (default): workers speak the framed wire
+        # protocol — the multi-host-authentic path, also used co-located.
+        # transport="inproc": co-located workers call the hub's
+        # pull_direct/commit_direct under its lock — no sockets, no
+        # framing; identical training trajectories (the parity property
+        # tests/test_transport.py pins).  Requires owning the hub.
+        if transport not in ("socket", "inproc"):
+            raise ValueError(f"transport must be 'socket' or 'inproc', "
+                             f"got {transport!r}")
+        if transport == "inproc" and ps_address is not None:
+            raise ValueError(
+                "transport='inproc' requires a co-located hub (the trainer "
+                "starts its own); worker-only mode with ps_address needs "
+                "transport='socket'")
+        self.transport = transport
+        # pipeline=True (default): the pull for window k+1 is prefetched
+        # while window k computes, and commit acks coalesce into later
+        # receives (at most max_inflight_commits ride unacknowledged) —
+        # wall-per-window converges toward max(compute, wire).  The pull
+        # for k+1 then observes the center BEFORE this worker's commit k
+        # (deterministic self-staleness of 1; see ARCHITECTURE.md "Async
+        # transport").  pipeline=False restores the strictly serial
+        # pull -> train -> commit -> ack exchange per window.
+        self.pipeline = bool(pipeline)
+        self.max_inflight_commits = int(max_inflight_commits)
         # "int8": workers send action-Q commits (4x fewer wire bytes,
         # error feedback client-side — see PSClient); pulls stay f32.
         # Both hubs (Python and C++) accept either commit form.
@@ -261,21 +300,34 @@ class AsyncDistributedTrainer(Trainer):
                 m_started.inc()
             try:
                 device = devices[idx % len(devices)]
-                client = PSClient(ps_host, ps_port, templates=flat0,
-                                  compress=self.compress_commits)
+                if self.transport == "inproc":
+                    client = InprocPSClient(ps, templates=flat0,
+                                            compress=self.compress_commits)
+                else:
+                    client = PSClient(ps_host, ps_port, templates=flat0,
+                                      compress=self.compress_commits,
+                                      max_inflight=self.max_inflight_commits)
+                pipeline = self.pipeline
                 try:
                     shard = dataset.shard(self.num_workers, idx)
                     # worker state lives on the device for the whole run;
                     # each window touches the host only for the PS wire
-                    # exchange (pull in, commit out) and the feed slices
-                    params = jax.device_put(unflatten(client.pull()), device)
+                    # exchange (pull in, commit out) and the feed slices.
+                    # np.array: the socket client's pull buffers are reused
+                    # by later prefetches, and params must own its storage
+                    params = jax.device_put(
+                        unflatten([np.array(w) for w in client.pull()]), device)
                     opt_state = jax.device_put(self.optimizer.init(params), device)
+                    # one pull rides ahead of the window being computed (set
+                    # when the previous window prefetched this window's pull)
+                    pull_pending = False
                     for epoch in range(self.num_epoch):
                         ds = shard.shuffle(seed=self.seed + 1000 * idx + epoch) if shuffle else shard
                         stacked = ds.stacked_epoch(self.batch_size,
                                                    [self.features_col, self.label_col],
                                                    window=self.communication_window)
                         xs, ys = stacked[self.features_col], stacked[self.label_col]
+                        n_windows = xs.shape[0]
                         # with telemetry ON, window slices ride the shared
                         # feed machinery with a no-op place: the producer
                         # thread stages (wx, wy) views one window ahead and
@@ -295,14 +347,30 @@ class AsyncDistributedTrainer(Trainer):
                             t_wall = time.perf_counter() if telemetry else 0.0
                             with obs.span("async.window", worker=idx,
                                           epoch=epoch, window=w):
+                                if not pull_pending:
+                                    client.pull_nowait()
+                                pulled_host = client.wait_weights()
+                                pull_pending = False
                                 # ONE batched H2D per window (center + feed
                                 # slices) — on a relayed device every transfer
                                 # call is a host round trip, so they are fused
                                 pulled, wx, wy = jax.device_put(
-                                    (unflatten(client.pull()), wx_h, wy_h), device)
+                                    (unflatten(pulled_host), wx_h, wy_h), device)
                                 t_dev = time.perf_counter() if telemetry else 0.0
                                 params, opt_state, commit, mloss = window_fn(
                                     params, opt_state, pulled, wx, wy)
+                                # prefetch the NEXT window's pull while this
+                                # window's program runs: the request leaves
+                                # now (jax dispatch is async) and the weights
+                                # stream into the other landing buffer under
+                                # the compute — the center it snapshots
+                                # predates this window's commit below
+                                # (self-staleness 1; ARCHITECTURE.md)
+                                last_window = (w == n_windows - 1
+                                               and epoch == self.num_epoch - 1)
+                                if pipeline and not last_window:
+                                    client.pull_nowait()
+                                    pull_pending = True
                                 if telemetry:
                                     # block on the window program ONLY when
                                     # measuring: dispatch-to-completion is
@@ -313,7 +381,13 @@ class AsyncDistributedTrainer(Trainer):
                                     m_dev.observe(time.perf_counter() - t_dev)
                                 # one batched D2H for the payload; leaf order is
                                 # the same tree.flatten order as the templates
-                                client.commit(jax.tree.leaves(jax.device_get(commit)))
+                                payload = jax.tree.leaves(jax.device_get(commit))
+                                if pipeline:
+                                    # fire-and-forget: the ack coalesces into
+                                    # the next window's weights receive
+                                    client.commit_nowait(payload)
+                                else:
+                                    client.commit(payload)
                             if telemetry:
                                 m_wall.observe(time.perf_counter() - t_wall)
                                 m_windows.inc()
@@ -321,6 +395,10 @@ class AsyncDistributedTrainer(Trainer):
                             # float() here would add one more blocking round
                             # trip per window
                             losses.append(mloss)
+                    # trailing acks (and nothing else: the last window never
+                    # prefetches) — commits must be APPLIED before the run's
+                    # final center read, not just queued on the wire
+                    client.drain()
                 finally:
                     client.close()
             except BaseException as e:  # surface worker crashes to the driver

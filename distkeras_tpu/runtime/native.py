@@ -108,6 +108,10 @@ def _bind_ps(lib: ctypes.CDLL) -> None:
     lib.dk_ps_num_updates.argtypes = [ctypes.c_void_p]
     lib.dk_ps_port.restype = ctypes.c_int
     lib.dk_ps_port.argtypes = [ctypes.c_void_p]
+    lib.dk_ps_pull.restype = ctypes.c_int64
+    lib.dk_ps_pull.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+    lib.dk_ps_commit.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+                                 ctypes.c_int64]
     lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
 
 
@@ -170,6 +174,39 @@ class NativeParameterServer:
             result.append(out[off:off + t.size].reshape(t.shape).copy())
             off += t.size
         return result
+
+    # -- in-process transport (transport="inproc") -----------------------------
+    # Mirrors SocketParameterServer.pull_direct/commit_direct: co-located
+    # workers exchange with the C++ center through two ctypes calls (both
+    # release the GIL for the memcpy/apply), no sockets, no framing.
+
+    def pull_direct(self):
+        """(center tensors, clock at snapshot) — the clock rides back in
+        with the matching :meth:`commit_direct`."""
+        flat = np.empty(self._total, np.float32)
+        clock = int(self._lib.dk_ps_pull(
+            self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+        out, off = [], 0
+        for t in self._templates:
+            out.append(flat[off:off + t.size].reshape(t.shape))
+            off += t.size
+        return out, clock
+
+    def commit_direct(self, delta: Sequence[np.ndarray], last_pull_clock: int) -> None:
+        if len(delta) != len(self._templates):
+            raise ValueError(f"commit has {len(delta)} tensors, center has "
+                             f"{len(self._templates)}")
+        parts = []
+        for d, t in zip(delta, self._templates):
+            a = np.ascontiguousarray(d, dtype=np.float32).reshape(-1)
+            if a.size != t.size:
+                raise ValueError(f"commit tensor size {a.size} != center "
+                                 f"size {t.size}")
+            parts.append(a)
+        flat = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+        self._lib.dk_ps_commit(
+            self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(last_pull_clock))
 
     @property
     def num_updates(self) -> int:

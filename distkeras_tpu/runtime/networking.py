@@ -23,6 +23,22 @@ Wire format (all integers big-endian):
 Actions: ``P`` pull request, ``C`` commit, ``Q`` int8-compressed commit,
 ``B`` bye, ``W`` weights reply, ``A`` ack.
 
+Two implementations move tensor frames:
+
+- the **generic path** (:func:`send_tensors` / :func:`recv_tensors`) builds
+  and parses frames ad hoc — control plane, tests, peers without a shared
+  schema;
+- the **flat path** (:class:`FlatFrameCodec`, :func:`recv_frame_into`,
+  :func:`decode_tensor_views`) moves the SAME bytes through preallocated
+  storage for connections with a fixed tensor schema (the PS pull/commit
+  hot loop): the send frame is built once with every constant byte
+  prewritten and per message only the action byte and tensor payloads are
+  stamped in (one ``memcpy`` per tensor, zero intermediate ``bytes``),
+  while receives scatter straight into the caller's arrays with
+  ``recv_into`` — the payload is written exactly once, by the kernel, at
+  its final destination.  Wire bytes are identical between the two paths,
+  so the C++ hub and pre-existing peers interoperate unchanged.
+
 ``Q`` commits carry each tensor as a 4-byte big-endian float32 scale
 followed by the int8-quantized values (symmetric per-tensor:
 ``q = round(d / scale)``, ``scale = max|d| / 127``) — 4x fewer wire
@@ -70,24 +86,61 @@ def determine_host_address() -> str:
         s.close()
 
 
-def connect(host: str, port: int, disable_nagle: bool = True, timeout: Optional[float] = None) -> socket.socket:
-    """TCP connect (reference: ``networking.connect``); Nagle off by default —
-    the PS exchange is request/response and latency-bound."""
-    sock = socket.create_connection((host, port), timeout=timeout)
-    if disable_nagle:
+MIN_SOCKET_BUF = 64 << 10   # floor for SO_SNDBUF/SO_RCVBUF requests
+MAX_SOCKET_BUF = 8 << 20    # cap — beyond one large frame, memory not speed
+
+
+def configure_socket(sock: socket.socket, payload_hint: Optional[int] = None,
+                     nodelay: bool = True) -> None:
+    """Hot-path tuning applied to BOTH ends of every PS/client connection.
+
+    - ``TCP_NODELAY``: the exchange is strictly request/response, so Nagle
+      buys nothing and its interaction with delayed acks can park the
+      13-byte ack/pull frames for tens of milliseconds — longer than an
+      entire training window.
+    - ``SO_SNDBUF``/``SO_RCVBUF`` sized to ``payload_hint`` (one full
+      weights/commit frame, clamped to [64 KiB, 8 MiB]): a pipelined
+      sender must be able to park a whole commit in the kernel and return
+      to compute instead of blocking in ``sendall`` at the default buffer
+      size.  Best-effort — the kernel may clamp further.  Without a hint
+      the kernel defaults stand (control-plane connections don't need
+      frame-sized buffers)."""
+    if nodelay:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if payload_hint is None:
+        return
+    size = max(MIN_SOCKET_BUF, min(int(payload_hint) + 4096, MAX_SOCKET_BUF))
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, size)
+        except OSError:
+            pass  # kernel policy may forbid resizing; defaults still work
+
+
+def connect(host: str, port: int, disable_nagle: bool = True,
+            timeout: Optional[float] = None,
+            payload_hint: Optional[int] = None) -> socket.socket:
+    """TCP connect (reference: ``networking.connect``); Nagle off by default —
+    the PS exchange is request/response and latency-bound.  ``payload_hint``
+    sizes the kernel buffers to the frame this connection will move."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    configure_socket(sock, payload_hint=payload_hint, nodelay=disable_nagle)
     return sock
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket (zero-copy receive)."""
+    got, n = 0, view.nbytes
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError(f"peer closed mid-frame ({got}/{n} bytes)")
         got += r
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
     return bytes(buf)
 
 
@@ -115,6 +168,43 @@ def recv_frame(sock: socket.socket, limit: int = MAX_FRAME) -> bytes:
         obs.counter("net_rx_frames_total").inc()
         obs.counter("net_rx_bytes_total").inc(8 + n)
     return payload
+
+
+def recv_frame_into(sock: socket.socket, buf: bytearray,
+                    limit: int = MAX_FRAME) -> memoryview:
+    """Receive one frame into the reusable ``buf`` (grown once to the
+    largest frame seen, then steady-state zero-allocation), returning a
+    memoryview of exactly the payload bytes.  The view aliases ``buf`` —
+    it is valid only until the next call.  This is the long-lived-
+    connection receive: the PS hub's handler loop reads every request
+    through one of these per connection."""
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    if n > limit:
+        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
+    if len(buf) < n:
+        try:
+            buf.extend(bytes(n - len(buf)))
+        except BufferError:
+            # live views of the previous frame pin the caller's buffer
+            # (bytearray cannot resize with exports outstanding); receive
+            # this oversized frame into a fresh buffer instead — the
+            # caller's steady-state buffer is simply not grown this time
+            buf = bytearray(n)
+    mv = memoryview(buf)[:n]
+    _recv_exact_into(sock, mv)
+    if obs.enabled():
+        obs.counter("net_rx_frames_total").inc()
+        obs.counter("net_rx_bytes_total").inc(8 + n)
+    return mv
+
+
+def send_raw_frame(sock: socket.socket, frame: bytes) -> None:
+    """Send an already-framed byte string (8-byte header included) — for
+    prebuilt constant frames (acks, pull requests) on the hot path."""
+    sock.sendall(frame)
+    if obs.enabled():
+        obs.counter("net_tx_frames_total").inc()
+        obs.counter("net_tx_bytes_total").inc(len(frame))
 
 
 # -- control plane: JSON frames -----------------------------------------------
@@ -153,11 +243,180 @@ def decode_tensors(payload: bytes) -> Tuple[bytes, List[bytes]]:
     return action, blobs
 
 
+def decode_tensor_views(payload) -> Tuple[bytes, List[memoryview]]:
+    """:func:`decode_tensors` without the copies: blobs come back as
+    memoryview slices into ``payload`` (pass the ``recv_frame_into`` view
+    directly).  The views alias the receive buffer — decode/apply them
+    before the next frame lands."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    action = bytes(mv[0:1])
+    (count,) = struct.unpack(">I", mv[1:5])
+    blobs: List[memoryview] = []
+    off = 5
+    for _ in range(count):
+        (nbytes,) = struct.unpack(">Q", mv[off:off + 8])
+        off += 8
+        if off + nbytes > len(mv):
+            raise ValueError("tensor frame truncated mid-blob")
+        blobs.append(mv[off:off + nbytes])
+        off += nbytes
+    if off != len(mv):
+        raise ValueError(f"tensor frame has {len(mv) - off} trailing bytes")
+    return action, blobs
+
+
+def _scatter_recv_into(sock: socket.socket, out: Sequence[np.ndarray],
+                       scratch: memoryview, limit: int) -> bytes:
+    """The one scatter-receive core (shared by ``FlatFrameCodec.recv_into``
+    and the templated ``recv_tensors`` path, so their frame validation can
+    never drift apart): read one tensor frame whose layout must match
+    ``out`` exactly — prefixes land in the 13-byte ``scratch``, payloads
+    land in ``out`` via ``recv_into`` — and return the action byte.  Any
+    mismatch raises ``ValueError`` with the stream desynchronized."""
+    _recv_exact_into(sock, scratch[:8])
+    (n,) = struct.unpack(">Q", scratch[:8])
+    if n > limit:
+        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
+    expected = 5 + sum(8 + a.nbytes for a in out)
+    if n != expected:
+        raise ValueError(f"tensor frame of {n} payload bytes does not match "
+                         f"the expected layout ({expected} bytes)")
+    _recv_exact_into(sock, scratch[:5])
+    action = bytes(scratch[:1])
+    (count,) = struct.unpack(">I", scratch[1:5])
+    if count != len(out):
+        raise ValueError(f"frame has {count} tensors, expected {len(out)}")
+    for dst in out:
+        _recv_exact_into(sock, scratch[:8])
+        (nbytes,) = struct.unpack(">Q", scratch[:8])
+        if nbytes != dst.nbytes or not dst.flags.c_contiguous:
+            raise ValueError(f"tensor of {nbytes} bytes does not match its "
+                             f"output slot ({dst.nbytes} bytes, contiguous)")
+        _recv_exact_into(sock, memoryview(dst).cast("B"))
+    if obs.enabled():
+        obs.counter("net_rx_frames_total").inc()
+        obs.counter("net_rx_bytes_total").inc(8 + n)
+    return action
+
+
+def empty_tensor_frame(action: bytes) -> bytes:
+    """The complete 13-byte frame of a tensor-less message (pull request,
+    ack, bye) — header included, built once and reused via
+    :func:`send_raw_frame`."""
+    return struct.pack(">Q", 5) + action + struct.pack(">I", 0)
+
+
+def recv_action(sock: socket.socket) -> bytes:
+    """Receive a frame known to carry zero tensors (the ack/control leg of
+    the pipelined client) and return its action byte."""
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    if n != 5:
+        raise ValueError(f"expected a tensor-less frame, got {n}-byte payload")
+    payload = _recv_exact(sock, 5)
+    (count,) = struct.unpack(">I", payload[1:5])
+    if count != 0:
+        raise ValueError(f"expected zero tensors, frame declares {count}")
+    if obs.enabled():
+        obs.counter("net_rx_frames_total").inc()
+        obs.counter("net_rx_bytes_total").inc(8 + n)
+    return payload[0:1]
+
+
 def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:
     """Exact wire size of ``encode_tensors(action, arrays)`` — kept next to
     the encoder so senders can pre-flight size limits without duplicating
     the frame layout."""
     return 5 + sum(8 + np.asarray(a).nbytes for a in arrays)
+
+
+class FlatFrameCodec:
+    """Zero-copy tensor framing for a FIXED schema (the PS hot path).
+
+    Both directions of the pull/commit exchange move frames whose layout
+    is fully determined by the tensor templates; only the action byte and
+    the tensor payloads vary per message.  So the codec derives all
+    offsets once at construction:
+
+    - **send** (:meth:`pack` + :meth:`send_packed`, or :meth:`send`): one
+      frame buffer holds the prewritten frame length, tensor count, and
+      per-tensor length prefixes; per message the action byte is stamped
+      and each tensor is memcpy'd into its slot through a writable numpy
+      view, then the whole frame leaves in a single
+      ``sendall(memoryview)``.  Zero allocations, zero intermediate
+      ``bytes`` — this replaces the per-tensor ``tobytes()`` + ``join``
+      of the generic encoder.
+    - **recv_into**: the frame is scatter-read with ``recv_into``
+      directly into caller-provided preallocated arrays; prefixes land in
+      a small reusable scratch and are validated against the schema.
+
+    Wire bytes are IDENTICAL to :func:`encode_tensors`, so either end may
+    be a generic peer (including the C++ hub).  Not thread-safe: one
+    codec per connection/direction owner.  After any mid-frame exception
+    the stream is desynchronized — drop the connection."""
+
+    def __init__(self, templates: Sequence[np.ndarray]):
+        self.templates = [np.asarray(t) for t in templates]
+        self.payload_len = 5 + sum(8 + t.nbytes for t in self.templates)
+        self.frame_len = 8 + self.payload_len
+        self._tx = bytearray(self.frame_len)
+        mv = memoryview(self._tx)
+        struct.pack_into(">Q", self._tx, 0, self.payload_len)
+        struct.pack_into(">I", self._tx, 9, len(self.templates))
+        self._tx_slots: List[np.ndarray] = []
+        pos = 13
+        for t in self.templates:
+            struct.pack_into(">Q", self._tx, pos, t.nbytes)
+            pos += 8
+            self._tx_slots.append(np.frombuffer(mv[pos:pos + t.nbytes],
+                                                dtype=t.dtype))
+            pos += t.nbytes
+        self._tx_mv = mv
+        self._scratch = memoryview(bytearray(13))
+
+    def pack(self, action: bytes, arrays: Sequence[np.ndarray]) -> None:
+        """Stamp ``action`` and memcpy each tensor into its frame slot.
+        Split from :meth:`send_packed` so a server can pack under its
+        center lock and send after releasing it."""
+        if len(arrays) != len(self.templates):
+            raise ValueError(f"got {len(arrays)} tensors, schema has "
+                             f"{len(self.templates)}")
+        self._tx[8:9] = action
+        for slot, tmpl, a in zip(self._tx_slots, self.templates, arrays):
+            a = np.asarray(a)
+            if a.dtype != tmpl.dtype or a.size != tmpl.size:
+                raise ValueError(f"tensor {a.dtype}[{a.size}] does not match "
+                                 f"schema {tmpl.dtype}[{tmpl.size}]")
+            slot[...] = a.reshape(-1)
+
+    def send_packed(self, sock: socket.socket) -> None:
+        sock.sendall(self._tx_mv)
+        if obs.enabled():
+            obs.counter("net_tx_frames_total").inc()
+            obs.counter("net_tx_bytes_total").inc(self.frame_len)
+
+    def send(self, sock: socket.socket, action: bytes,
+             arrays: Sequence[np.ndarray]) -> None:
+        self.pack(action, arrays)
+        self.send_packed(sock)
+
+    def recv_into(self, sock: socket.socket,
+                  out: Sequence[np.ndarray]) -> bytes:
+        """Scatter-receive one frame of this schema directly into ``out``
+        (preallocated, C-contiguous, template-shaped) and return the
+        action byte.  Any schema mismatch raises ``ValueError`` with the
+        stream desynchronized — callers drop the connection."""
+        if len(out) != len(self.templates):
+            raise ValueError(f"got {len(out)} output slots, schema has "
+                             f"{len(self.templates)}")
+        for tmpl, dst in zip(self.templates, out):
+            if dst.nbytes != tmpl.nbytes:
+                raise ValueError(f"output slot of {dst.nbytes} bytes does "
+                                 f"not match schema ({tmpl.nbytes} bytes)")
+        # out now mirrors the schema exactly, so the shared core's
+        # layout-vs-out validation IS the schema validation (and
+        # limit=payload_len rejects any differently-sized frame outright)
+        return _scatter_recv_into(sock, out, self._scratch,
+                                  limit=self.payload_len)
 
 
 # -- int8 commit compression (action Q blobs) ---------------------------------
@@ -189,20 +448,26 @@ def send_tensors(sock: socket.socket, action: bytes, arrays: Sequence[np.ndarray
 
 
 def recv_tensors(sock: socket.socket, templates: Optional[Sequence[np.ndarray]] = None,
-                 limit: int = MAX_FRAME) -> Tuple[bytes, List[np.ndarray]]:
-    """Receive an (action, tensors) frame.  With ``templates``, each blob is
-    reinterpreted with the template's dtype/shape (the out-of-band schema);
-    without, raw ``uint8`` arrays are returned."""
-    action, blobs = decode_tensors(recv_frame(sock, limit=limit))
-    if templates is None:
+                 limit: int = MAX_FRAME,
+                 out: Optional[Sequence[np.ndarray]] = None) -> Tuple[bytes, List[np.ndarray]]:
+    """Receive an (action, tensors) frame.
+
+    With ``templates`` (the out-of-band schema) the frame is scatter-read
+    with ``recv_into`` DIRECTLY into the result arrays — freshly allocated
+    from the templates, or the caller's preallocated ``out`` — so the
+    payload is written exactly once, by the kernel, at its destination (no
+    intermediate frame buffer, no per-blob slice copies).  A frame that
+    does not match the template layout raises ``ValueError`` with the
+    stream desynchronized — drop the connection.
+
+    Without templates, raw ``uint8`` copies are returned (the
+    control-plane path: tolerant of any tensor count/size)."""
+    if templates is None and out is None:
+        action, blobs = decode_tensors(recv_frame(sock, limit=limit))
         return action, [np.frombuffer(b, dtype=np.uint8) for b in blobs]
-    if len(blobs) != len(templates):
-        raise ValueError(f"got {len(blobs)} tensors, template has {len(templates)}")
-    out = []
-    for blob, tmpl in zip(blobs, templates):
-        t = np.asarray(tmpl)
-        arr = np.frombuffer(blob, dtype=t.dtype)
-        if arr.size != t.size:
-            raise ValueError(f"tensor size {arr.size} != template size {t.size}")
-        out.append(arr.reshape(t.shape))
-    return action, out
+    if out is None:
+        out = [np.empty(np.asarray(t).shape, np.asarray(t).dtype)
+               for t in templates]
+    action = _scatter_recv_into(sock, out, memoryview(bytearray(13)),
+                                limit=limit)
+    return action, list(out)

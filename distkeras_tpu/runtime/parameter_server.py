@@ -9,12 +9,19 @@ DOWNPOUR/EASGD family (SURVEY §7 "hard parts", option b), used when worker
 processes drive their own chips over DCN — with three changes:
 
 - the wire protocol is raw tensor frames, not pickle
-  (:mod:`distkeras_tpu.runtime.networking`);
+  (:mod:`distkeras_tpu.runtime.networking`) — moved through the zero-copy
+  flat path (preallocated frames, ``recv_into`` scatter receives), with
+  a pipelined client (prefetched pulls, coalesced acks) for the async
+  trainers' hot loop;
 - the center is a flat ``float32`` weight list (the pytree structure stays
   with the trainer), so commits are pure vectorized numpy adds;
 - the same protocol is implemented by a C++ hub
   (:mod:`distkeras_tpu.runtime.native`) that applies commits without the
-  GIL; this Python hub is the portable fallback and the executable spec.
+  GIL; this Python hub is the portable fallback and the executable spec;
+- co-located workers may skip the wire entirely: ``pull_direct`` /
+  ``commit_direct`` (and :class:`InprocPSClient` over them) run the same
+  center logic under the same lock — the ``transport="inproc"`` path,
+  trajectory-identical to sockets (ARCHITECTURE.md "Async transport").
 
 Server classes mirror the reference's:
 ``SocketParameterServer`` (base, pull/commit loop),
@@ -27,7 +34,8 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Optional, Sequence
+from collections import deque
+from typing import Any, Deque, List, Optional, Sequence, Tuple
 
 import time
 
@@ -66,8 +74,13 @@ class SocketParameterServer:
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._handlers: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []  # live worker connections
+        self._conn_lock = threading.Lock()
         self._running = False
         self._center_bytes = sum(w.nbytes for w in self.center)
+        # full flat-frame size of a pull reply / f32 commit (header, action,
+        # count, per-tensor prefixes, payload) — the socket-buffer hint
+        self._frame_bytes = 13 + sum(8 + w.nbytes for w in self.center)
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
 
     # -- lifecycle (reference: ParameterServer.start/stop) ---------------------
@@ -85,9 +98,29 @@ class SocketParameterServer:
         self._running = False
         if self._listener is not None:
             try:
+                # shutdown BEFORE close: close() alone does not wake a
+                # thread blocked in accept() on Linux, so every stop()
+                # silently burned the full join timeout and leaked the
+                # accept thread.  shutdown() fails the pending accept
+                # immediately (same idiom as the C++ hub's stop())
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # not listening / already gone; close still applies
+            try:
                 self._listener.close()
             except OSError:
                 pass
+        # sever live worker connections (matching the C++ hub): a blocked
+        # handler wakes with EOF and exits, and the worker's next receive
+        # surfaces a clean ConnectionError instead of hanging on a hub
+        # that will never reply — the fault-injection behavior
+        # tests/test_runtime.py pins
+        with self._conn_lock:
+            for conn in list(self._conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         for t in self._handlers:
@@ -105,7 +138,24 @@ class SocketParameterServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 break  # listener closed by stop()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # registration races stop(): linearize on _conn_lock — either
+            # this append lands before stop()'s sever loop (which then
+            # shuts the conn down), or we observe _running False here and
+            # close it ourselves.  Without the re-check a conn accepted in
+            # the gap would spawn a handler that blocks in recv forever,
+            # resurrecting the leaked-handler stall stop() just fixed
+            with self._conn_lock:
+                if not self._running:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    break
+                self._conns.append(conn)
+            # Nagle off + kernel buffers sized to one full weights/commit
+            # frame: the pipelined client parks a commit in the send buffer
+            # and returns to compute instead of blocking in sendall
+            net.configure_socket(conn, payload_hint=self._frame_bytes)
             # ordinal wraps at a fixed slot count so the staleness gauge's
             # label cardinality stays bounded even under elastic-run
             # connection churn (ordinals already restart at 0 per hub,
@@ -115,14 +165,21 @@ class SocketParameterServer:
             t = threading.Thread(target=self._handle_connection,
                                  args=(conn, conn_idx), daemon=True)
             t.start()
+            # prune finished handlers as connections churn: a long-lived
+            # hub under elastic reconnects must not accumulate one dead
+            # Thread object per connection ever accepted
+            self._handlers = [h for h in self._handlers if h.is_alive()]
             self._handlers.append(t)
 
     def _decode_delta(self, blobs) -> List[np.ndarray]:
+        """f32 commit: reinterpret each wire blob in place (zero-copy views
+        into the connection's receive buffer, consumed before the next
+        frame overwrites it)."""
         if len(blobs) != len(self.center):
             raise ValueError(f"commit has {len(blobs)} tensors, center has {len(self.center)}")
         out = []
         for blob, c in zip(blobs, self.center):
-            arr = np.frombuffer(np.asarray(blob).tobytes(), dtype=c.dtype)
+            arr = np.frombuffer(blob, dtype=c.dtype)
             if arr.size != c.size:
                 raise ValueError(f"commit tensor size {arr.size} != center size {c.size}")
             out.append(arr.reshape(c.shape))
@@ -132,23 +189,34 @@ class SocketParameterServer:
         """int8 commit (action Q): per-tensor f32 scale + int8 values."""
         if len(blobs) != len(self.center):
             raise ValueError(f"commit has {len(blobs)} tensors, center has {len(self.center)}")
-        return [net.dequantize_q_blob(np.asarray(blob).tobytes(), c.size).reshape(c.shape)
+        return [net.dequantize_q_blob(blob, c.size).reshape(c.shape)
                 for blob, c in zip(blobs, self.center)]
 
     def _handle_connection(self, conn: socket.socket, conn_idx: int = 0) -> None:
         last_pull_clock = 0
+        # per-connection reusable storage: the receive buffer grows once to
+        # the largest frame this worker sends (a commit), the reply codec
+        # holds one prepacked weights frame, the ack is a 13-byte constant
+        # — steady-state the handler loop allocates nothing
+        rx = bytearray(self._frame_bytes)
+        reply = net.FlatFrameCodec(self.center)
+        ack = net.empty_tensor_frame(net.ACTION_ACK)
         try:
             while True:
                 # raw receive: pull/bye carry zero tensors, commit carries
                 # len(center) — decode against the center only on commit
-                action, blobs = net.recv_tensors(conn)
+                payload = net.recv_frame_into(conn, rx)
+                action, blobs = net.decode_tensor_views(payload)
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
                     with self._lock:
-                        snapshot = [w.copy() for w in self.center]
+                        # pack the center STRAIGHT into the reply frame (one
+                        # memcpy per tensor) under the lock; the send happens
+                        # after release so a slow peer can't hold the center
+                        reply.pack(net.ACTION_WEIGHTS, self.center)
                         last_pull_clock = self._clock
-                    net.send_tensors(conn, net.ACTION_WEIGHTS, snapshot)
+                    reply.send_packed(conn)
                     if telemetry:
                         obs.counter("ps_pulls_total").inc()
                         obs.counter("ps_pull_bytes_total").inc(self._center_bytes)
@@ -163,11 +231,11 @@ class SocketParameterServer:
                         self.apply_commit(delta, staleness)
                         self.num_updates += 1
                         self._clock += 1
-                    net.send_tensors(conn, net.ACTION_ACK, [])
+                    net.send_raw_frame(conn, ack)
                     if telemetry:
                         obs.counter("ps_commits_total").inc()
                         obs.counter("ps_commit_bytes_total").inc(
-                            sum(np.asarray(b).nbytes for b in blobs))
+                            sum(b.nbytes for b in blobs))
                         obs.histogram("ps_rpc_seconds", rpc="commit").observe(
                             time.perf_counter() - t0)
                         # per-connection staleness: commits the hub applied
@@ -177,6 +245,7 @@ class SocketParameterServer:
                         # telemetry off never registers per-connection state
                         obs.gauge("ps_staleness",
                                   conn=str(conn_idx)).set(staleness)
+                        obs.histogram("ps_commit_staleness").observe(staleness)
                 elif action == net.ACTION_BYE:
                     break
                 else:
@@ -188,6 +257,60 @@ class SocketParameterServer:
                 conn.close()
             except OSError:
                 pass
+            # forget the socket so stop() never shuts down an unrelated
+            # descriptor that reuses this slot
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    # -- in-process transport (transport="inproc") -----------------------------
+    # Co-located workers skip sockets and framing entirely and call the
+    # SAME center logic the handlers run, under the same lock.  The pair
+    # below is the whole inproc wire protocol: pull_direct is the 'P'
+    # branch minus the frame, commit_direct is the 'C' branch minus the
+    # decode.  The C++ hub exposes the same pair (runtime/native.py), so
+    # InprocPSClient works against either hub.
+
+    def pull_direct(self) -> Tuple[List[np.ndarray], int]:
+        """Snapshot (center copy, clock at snapshot) — the caller passes the
+        clock back with its commit, exactly like a socket worker's
+        connection state does."""
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        with self._lock:
+            snapshot = [w.copy() for w in self.center]
+            clock = self._clock
+        if telemetry:
+            obs.counter("ps_pulls_total").inc()
+            obs.histogram("ps_rpc_seconds", rpc="pull.inproc").observe(
+                time.perf_counter() - t0)
+        return snapshot, clock
+
+    def commit_direct(self, delta: Sequence[np.ndarray], last_pull_clock: int) -> None:
+        """Apply one commit with the staleness implied by ``last_pull_clock``
+        (the value returned by the matching :meth:`pull_direct`)."""
+        if len(delta) != len(self.center):
+            raise ValueError(f"commit has {len(delta)} tensors, center has {len(self.center)}")
+        for d, c in zip(delta, self.center):
+            if np.asarray(d).size != c.size:
+                raise ValueError(f"commit tensor size {np.asarray(d).size} != "
+                                 f"center size {c.size}")
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        # dtype/shape normalization outside the lock (no-op views for the
+        # trainers' float32 payloads)
+        arrays = [np.asarray(d, np.float32).reshape(c.shape)
+                  for d, c in zip(delta, self.center)]
+        with self._lock:
+            staleness = self._clock - last_pull_clock
+            self.apply_commit(arrays, staleness)
+            self.num_updates += 1
+            self._clock += 1
+        if telemetry:
+            obs.counter("ps_commits_total").inc()
+            obs.histogram("ps_rpc_seconds", rpc="commit.inproc").observe(
+                time.perf_counter() - t0)
+            obs.histogram("ps_commit_staleness").observe(staleness)
 
     # -- commit rules ----------------------------------------------------------
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:  # pragma: no cover
@@ -229,67 +352,214 @@ class DynSGDParameterServer(SocketParameterServer):
             c += d * inv
 
 
+def _quantize_commit(delta: Sequence[np.ndarray],
+                     residual: List[np.ndarray]) -> List[np.ndarray]:
+    """Advance the int8 error-feedback chain one commit: quantize each
+    delta WITH its carried residual, store the new residual in place, and
+    return the wire blobs (uint8 arrays: be-f32 scale + int8 values).
+
+    The one implementation both transports call — the socket client frames
+    the blobs as an action-``Q`` message, the inproc client dequantizes
+    them right back — so the quantize/residual math can never fork between
+    transports (the bit-parity property ``tests/test_transport.py`` pins)."""
+    blobs = []
+    for i, d in enumerate(delta):
+        carried = np.asarray(d, np.float32) + residual[i]
+        blob, residual[i] = net.quantize_q_blob(carried)
+        blobs.append(np.frombuffer(blob, dtype=np.uint8))
+    return blobs
+
+
 class PSClient:
     """Worker-side connection: ``pull()`` / ``commit(delta)`` (reference:
-    ``NetworkWorker.pull/commit``, SURVEY §2.10).
+    ``NetworkWorker.pull/commit``, SURVEY §2.10) — plus the pipelined
+    fire-and-forget API the async hot path runs on
+    (``pull_nowait`` / ``wait_weights`` / ``commit_nowait`` / ``drain``).
+
+    Framing is the zero-copy flat path (:class:`~.networking.FlatFrameCodec`):
+    commits leave through one preallocated frame buffer (one memcpy per
+    tensor, single ``sendall``), pulls scatter-receive with ``recv_into``
+    into one of two reusable landing buffers — double-buffered because the
+    caller may still be consuming pull *k* while the prefetched pull *k+1*
+    streams in.  Arrays returned by ``pull``/``wait_weights`` therefore
+    alias client-owned storage that is REUSED two pulls later; copy
+    anything that must outlive that.
+
+    Pipelining: the nowait methods send a request and record the expected
+    reply in a FIFO; replies are consumed lazily, in wire order, by
+    ``wait_weights``/``drain`` — commit acks coalesce into the next
+    weights receive instead of costing their own blocking round trip.  At
+    most ``max_inflight`` commits ride unacknowledged (enforced by
+    consuming replies before sending more: wire back-pressure, not an
+    unbounded queue).  After any mid-frame error the stream is
+    desynchronized — the connection is single-use, callers drop it.
 
     ``compress="int8"`` sends commits as action-``Q`` frames — symmetric
-    per-tensor int8 with a float32 scale (4x fewer wire bytes) — and
-    keeps the quantization residual client-side, folding it into the
-    next commit (error feedback: the sum of dequantized commits tracks
-    the sum of true deltas, so compression does not bias the center).
+    per-tensor int8 with a float32 scale (4x fewer wire bytes) — keeping
+    the quantization residual client-side and folding it into the next
+    commit (error feedback: the sum of dequantized commits tracks the sum
+    of true deltas, so compression does not bias the center).  The
+    residual chain advances at QUANTIZATION time: pipelined commits have
+    no per-commit ack to gate on, and a dead connection is fatal to the
+    worker anyway (nothing reconnects and retries a half-sent commit).
     Pulls always stay full precision: weight error hits the model
-    directly, while delta rounding error is recycled."""
+    directly, while delta rounding error is recycled.
+
+    Telemetry (client side): ``ps.commit_bytes`` wire bytes,
+    ``ps.pull_latency_ms`` / ``ps.commit_latency_ms`` send-to-reply-
+    consumed latencies, ``ps.pull_stall_ms`` time actually BLOCKED waiting
+    for weights (the post-overlap stall the trainer pays),
+    ``ps.serialize_ms`` frame-pack time, ``ps.inflight_depth`` unacked
+    commits."""
 
     def __init__(self, host: str, port: int, templates: Sequence[np.ndarray],
                  timeout: Optional[float] = 60.0,
-                 compress: Optional[str] = None):
+                 compress: Optional[str] = None,
+                 max_inflight: int = 2):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
         self.compress = compress
         self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
                           if compress else None)
-        self.sock = net.connect(host, port, timeout=timeout)
+        self._codec = net.FlatFrameCodec(self.templates)
+        # int8 commits have their own fixed layout (4-byte scale + one int8
+        # per element), so they get their own preallocated frame
+        self._q_codec = (net.FlatFrameCodec(
+            [np.zeros(4 + t.size, np.uint8) for t in self.templates])
+            if compress == "int8" else None)
+        self.max_inflight = max(1, int(max_inflight))
+        self._pending: Deque[Tuple[bytes, float]] = deque()  # expected replies, wire order
+        self._pull_frame = net.empty_tensor_frame(net.ACTION_PULL)
+        self._pull_bufs = ([np.empty_like(t) for t in self.templates],
+                          [np.empty_like(t) for t in self.templates])
+        self._flip = 0
+        # weights replies consumed off the wire but not yet claimed by
+        # wait_weights (commit_nowait pre-drains them — see below); two
+        # landing buffers bound this queue at two entries
+        self._ready: Deque[List[np.ndarray]] = deque()
+        self.sock = net.connect(host, port, timeout=timeout,
+                                payload_hint=self._codec.frame_len)
 
+    # -- pipelined API ---------------------------------------------------------
+    def pull_nowait(self) -> None:
+        """Fire a pull request; the reply is consumed later by
+        :meth:`wait_weights`.  Issue it while the device computes and the
+        weights' wire time hides under the window."""
+        outstanding = (sum(1 for kind, _ in self._pending
+                           if kind == net.ACTION_WEIGHTS) + len(self._ready))
+        if outstanding >= 2:
+            raise RuntimeError("at most 2 pulls may be outstanding (two "
+                               "landing buffers); claim one with "
+                               "wait_weights() first")
+        net.send_raw_frame(self.sock, self._pull_frame)
+        self._pending.append((net.ACTION_WEIGHTS, time.perf_counter()))
+
+    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+        """Send a commit without waiting for its ack (coalesced into a later
+        receive).  Blocks only when ``max_inflight`` commits are already
+        unacknowledged."""
+        # the span covers the work the client actually does per commit
+        # (back-pressure + quantize/pack + send); the ack wait is measured
+        # separately by ps.commit_latency_ms when the reply is consumed
+        with obs.span("ps.commit", compress=self.compress or "none"):
+            # deadlock avoidance: never start a potentially-blocking large
+            # send while a weights reply may still be in flight — the hub
+            # does not read while it writes, so two big sendalls in
+            # opposite directions can fill both kernel buffers and stall
+            # forever once frames outgrow the socket buffers.  Claim any
+            # pending pull into its landing buffer first (wait_weights
+            # hands it out later); the hub is then parked in recv when the
+            # commit bytes arrive.  This receive time is pull wire-wait,
+            # so it lands in ps.pull_stall_ms like any other pull block.
+            if any(kind == net.ACTION_WEIGHTS for kind, _ in self._pending):
+                t_drain = time.perf_counter() if obs.enabled() else 0.0
+                while any(kind == net.ACTION_WEIGHTS
+                          for kind, _ in self._pending):
+                    self._consume_one()
+                if t_drain:
+                    obs.histogram("ps.pull_stall_ms").observe(
+                        (time.perf_counter() - t_drain) * 1e3)
+            while self._unacked() >= self.max_inflight:
+                self._consume_one()
+            telemetry = obs.enabled()
+            t0 = time.perf_counter() if telemetry else 0.0
+            if self.compress == "int8":
+                codec, action = self._q_codec, net.ACTION_QCOMMIT
+                arrays = _quantize_commit(delta, self._residual)
+            else:
+                codec, action = self._codec, net.ACTION_COMMIT
+                arrays = [np.asarray(d, np.float32) for d in delta]
+            codec.pack(action, arrays)
+            if telemetry:
+                obs.histogram("ps.serialize_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+                obs.counter("ps.commit_bytes").inc(codec.frame_len)
+            codec.send_packed(self.sock)
+            self._pending.append((net.ACTION_ACK, time.perf_counter()))
+            if telemetry:
+                obs.gauge("ps.inflight_depth").set(self._unacked())
+
+    def wait_weights(self) -> List[np.ndarray]:
+        """Hand out the oldest in-flight pull, consuming replies (and any
+        commit acks queued ahead of it) as needed."""
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        while not self._ready:
+            if not self._pending:
+                raise ConnectionError("wait_weights() with no pull in flight")
+            self._consume_one()
+        if telemetry:
+            obs.histogram("ps.pull_stall_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        return self._ready.popleft()
+
+    def drain(self) -> None:
+        """Consume every outstanding reply — trailing commit acks at the end
+        of a run, plus any prefetched pull that will go unused."""
+        while self._pending:
+            self._consume_one()
+        self._ready.clear()
+        if obs.enabled():
+            obs.gauge("ps.inflight_depth").set(0)
+
+    def _unacked(self) -> int:
+        return sum(1 for kind, _ in self._pending if kind == net.ACTION_ACK)
+
+    def _consume_one(self) -> None:
+        kind, t_sent = self._pending.popleft()
+        if kind == net.ACTION_ACK:
+            reply = net.recv_action(self.sock)
+            if reply != net.ACTION_ACK:
+                raise ConnectionError(f"expected ack, got {reply!r}")
+            if obs.enabled():
+                obs.histogram("ps.commit_latency_ms").observe(
+                    (time.perf_counter() - t_sent) * 1e3)
+                obs.gauge("ps.inflight_depth").set(self._unacked())
+        else:
+            out = self._pull_bufs[self._flip]
+            self._flip ^= 1
+            reply = self._codec.recv_into(self.sock, out)
+            if reply != net.ACTION_WEIGHTS:
+                raise ConnectionError(f"expected weights reply, got {reply!r}")
+            self._ready.append(out)
+            if obs.enabled():
+                obs.histogram("ps.pull_latency_ms").observe(
+                    (time.perf_counter() - t_sent) * 1e3)
+
+    # -- blocking API (control plane + non-pipelined callers) ------------------
     def pull(self) -> List[np.ndarray]:
         with obs.span("ps.pull"):
-            net.send_tensors(self.sock, net.ACTION_PULL, [])
-            action, tensors = net.recv_tensors(self.sock, templates=self.templates)
-        if action != net.ACTION_WEIGHTS:
-            raise ConnectionError(f"expected weights reply, got {action!r}")
-        return tensors
+            self.pull_nowait()
+            return self.wait_weights()
 
     def commit(self, delta: Sequence[np.ndarray]) -> None:
-        with obs.span("ps.commit", compress=self.compress or "none"):
-            self._commit(delta)
-
-    def _commit(self, delta: Sequence[np.ndarray]) -> None:
-        new_residuals = None
-        if self.compress == "int8":
-            action, arrays, new_residuals = net.ACTION_QCOMMIT, [], []
-            for i, d in enumerate(delta):
-                carried = np.asarray(d, np.float32) + self._residual[i]
-                blob, res = net.quantize_q_blob(carried)
-                arrays.append(np.frombuffer(blob, dtype=np.uint8))
-                new_residuals.append(res)
-        else:
-            action = net.ACTION_COMMIT
-            arrays = [np.asarray(d, np.float32) for d in delta]
-        net.send_tensors(self.sock, action, arrays)
-        reply, _ = net.recv_tensors(self.sock, templates=[])
-        if reply != net.ACTION_ACK:
-            raise ConnectionError(f"expected ack, got {reply!r}")
-        if new_residuals is not None:
-            # only a DELIVERED commit sheds its carried delta: updating the
-            # residual before the ack would lose a whole window's worth of
-            # update on a failed send, breaking the error-feedback
-            # invariant for callers that reconnect and retry
-            self._residual = new_residuals
+        self.commit_nowait(delta)
+        self.drain()
 
     def close(self) -> None:
         try:
-            net.send_tensors(self.sock, net.ACTION_BYE, [])
+            net.send_raw_frame(self.sock, net.empty_tensor_frame(net.ACTION_BYE))
         except OSError:
             pass
         finally:
@@ -299,6 +569,95 @@ class PSClient:
                 pass
 
     def __enter__(self) -> "PSClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class InprocPSClient:
+    """:class:`PSClient` surface over a co-located hub (``transport="inproc"``).
+
+    Pull/commit call the SAME center logic the socket handlers run —
+    ``pull_direct`` / ``commit_direct``, under the hub's lock — with no
+    sockets, no framing, and no wire copies; the staleness clock rides the
+    client object instead of a connection.  Works against the Python hubs
+    and the C++ hub (both expose the direct pair).
+
+    The nowait/wait methods execute EAGERLY at the exact program points
+    the socket client would *send* at, so a deterministic (single-worker)
+    schedule observes identical center states on both transports — the
+    trajectory-parity property ``tests/test_transport.py`` pins.
+
+    ``compress="int8"`` round-trips every commit through the same
+    quantize/dequantize + error-feedback math the wire path uses, so
+    compressed runs also stay trajectory-identical across transports."""
+
+    def __init__(self, ps: Any, templates: Sequence[np.ndarray],
+                 compress: Optional[str] = None):
+        if compress not in (None, "int8"):
+            raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
+        self.ps = ps
+        self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
+        self.compress = compress
+        self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
+                          if compress else None)
+        self._last_pull_clock = 0
+        self._pulled: Optional[List[np.ndarray]] = None
+
+    # -- pipelined API (eager) -------------------------------------------------
+    def pull_nowait(self) -> None:
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        weights, clock = self.ps.pull_direct()
+        self._last_pull_clock = clock
+        self._pulled = weights
+        if telemetry:
+            obs.histogram("ps.pull_latency_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    def wait_weights(self) -> List[np.ndarray]:
+        if self._pulled is None:
+            raise RuntimeError("wait_weights() with no pull in flight")
+        pulled, self._pulled = self._pulled, None
+        return pulled
+
+    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+        with obs.span("ps.commit", transport="inproc",
+                      compress=self.compress or "none"):
+            telemetry = obs.enabled()
+            t0 = time.perf_counter() if telemetry else 0.0
+            if self.compress == "int8":
+                # same quantize + residual advance as the wire path, then
+                # straight back through the dequantizer — what the hub
+                # would have reconstructed from the Q frame
+                blobs = _quantize_commit(delta, self._residual)
+                arrays = [net.dequantize_q_blob(memoryview(b), t.size)
+                          .reshape(t.shape)
+                          for b, t in zip(blobs, self.templates)]
+            else:
+                arrays = [np.asarray(d, np.float32) for d in delta]
+            self.ps.commit_direct(arrays, self._last_pull_clock)
+            if telemetry:
+                obs.histogram("ps.commit_latency_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+
+    def drain(self) -> None:
+        pass  # nothing rides in flight: commits apply synchronously
+
+    # -- blocking API ----------------------------------------------------------
+    def pull(self) -> List[np.ndarray]:
+        with obs.span("ps.pull", transport="inproc"):
+            self.pull_nowait()
+            return self.wait_weights()
+
+    def commit(self, delta: Sequence[np.ndarray]) -> None:
+        self.commit_nowait(delta)
+
+    def close(self) -> None:
+        pass  # no connection; the hub's lifecycle belongs to the trainer
+
+    def __enter__(self) -> "InprocPSClient":
         return self
 
     def __exit__(self, *exc) -> None:

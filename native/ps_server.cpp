@@ -149,6 +149,30 @@ class ParameterServer {
   int64_t num_updates() const { return num_updates_.load(); }
   int port() const { return bound_port_; }
 
+  // -- in-process transport (transport="inproc") ------------------------------
+  // The direct-call twins of the 'P' and 'C' wire branches: co-located
+  // Python workers (ctypes releases the GIL for the call) snapshot and
+  // commit under the same mutex the socket handlers take, with the
+  // staleness clock carried by the caller instead of a connection.
+
+  int64_t pull_direct(float* out) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(out, center_.data(), center_.size() * sizeof(float));
+    return clock_;
+  }
+
+  void commit_direct(const float* flat, int64_t last_pull_clock) {
+    std::vector<const float*> delta(sizes_.size());
+    const float* p = flat;
+    for (size_t i = 0; i < sizes_.size(); ++i) { delta[i] = p; p += sizes_[i]; }
+    {
+      std::lock_guard<std::mutex> g(center_mutex_);
+      apply_commit(delta.data(), clock_ - last_pull_clock);
+      ++clock_;
+    }
+    num_updates_.fetch_add(1);
+  }
+
  private:
   void accept_loop() {
     while (running_.load()) {
@@ -156,6 +180,14 @@ class ParameterServer {
       if (fd < 0) break;  // listener closed by stop()
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // kernel buffers sized to one full weights/commit frame (clamped to
+      // [64 KiB, 8 MiB], matching networking.configure_socket): a
+      // pipelined client must be able to park a whole commit in flight
+      int64_t want = 13 + 4096;
+      for (int64_t s : sizes_) want += 8 + s * int64_t(sizeof(float));
+      int bufsz = int(std::min<int64_t>(std::max<int64_t>(want, 64 << 10), 8 << 20));
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
       std::lock_guard<std::mutex> g(conn_mutex_);
       conn_fds_.push_back(fd);
       handler_threads_.emplace_back([this, fd] { handle_connection(fd); });
@@ -334,6 +366,10 @@ void dk_ps_get_weights(void* ps, float* out) { static_cast<ParameterServer*>(ps)
 void dk_ps_set_weights(void* ps, const float* in) { static_cast<ParameterServer*>(ps)->set_weights(in); }
 int64_t dk_ps_num_updates(void* ps) { return static_cast<ParameterServer*>(ps)->num_updates(); }
 int dk_ps_port(void* ps) { return static_cast<ParameterServer*>(ps)->port(); }
+int64_t dk_ps_pull(void* ps, float* out) { return static_cast<ParameterServer*>(ps)->pull_direct(out); }
+void dk_ps_commit(void* ps, const float* flat, int64_t last_pull_clock) {
+  static_cast<ParameterServer*>(ps)->commit_direct(flat, last_pull_clock);
+}
 void dk_ps_destroy(void* ps) { delete static_cast<ParameterServer*>(ps); }
 
 }  // extern "C"

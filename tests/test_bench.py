@@ -182,14 +182,48 @@ def test_moe_baseline_keys_cover_dispatch_legs():
 def test_async_baseline_keys_cover_new_legs():
     asy = {"workers": 2, "window": 8, "batch": 256,
            "async_adag_native": {"per_window_device_ms": 2.0},
-           "async_adag_int8": {"per_window_device_ms": 4.0}}
+           "async_adag_int8": {"per_window_device_ms": 4.0},
+           "async_adag_inproc": {"per_window_device_ms": 3.0}}
     baseline = {"legs": {
         "async:async_adag_native:w2x8b256:device-window":
-            {"per_window_device_ms": 4.0}}}
+            {"per_window_device_ms": 4.0},
+        "async:async_adag_inproc:w2x8b256:device-window":
+            {"per_window_device_ms": 6.0}}}
     out = {"async": asy}
     bench._apply_leg_baselines(out, baseline)
     assert asy["async_adag_native"]["vs_baseline"] == 2.0  # ms inverted
+    assert asy["async_adag_inproc"]["vs_baseline"] == 2.0  # ms inverted
     assert "vs_baseline" not in asy["async_adag_int8"]  # no record yet
+
+
+def test_async_acceptance_block_tripwires():
+    """The issue-3 acceptance block: vs-sync ratios + r05 speedup + final-
+    loss parity, with None (not a crash) wherever a leg errored out."""
+    out = {
+        "async_adag": {"samples_per_sec": 9000.0, "per_window_wall_ms": 42.0,
+                       "final_loss": 0.51},
+        "async_adag_inproc": {"samples_per_sec": 9500.0},
+        "async_adag_serial": {"samples_per_sec": 4800.0, "final_loss": 0.52},
+        "sync_adag": {"samples_per_sec": 10000.0},
+    }
+    bench._async_acceptance(out)
+    acc = out["acceptance"]
+    assert out["adag_vs_sync"] == 0.9 and acc["adag_vs_sync_ok"] is True
+    assert out["adag_inproc_vs_sync"] == 0.95 and acc["inproc_vs_sync_ok"] is True
+    assert acc["per_window_speedup_vs_r05"] == round(421.15 / 42.0, 2)
+    assert acc["per_window_speedup_ok"] is True
+    assert acc["final_loss_parity"]["abs_diff"] == 0.01
+
+    # a dead sync denominator degrades to None tripwires, not a KeyError
+    out2 = {"async_adag": {"samples_per_sec": 9000.0,
+                           "per_window_wall_ms": 500.0, "final_loss": 0.5},
+            "sync_adag": {"error": "AttributeError: no shard_map"}}
+    bench._async_acceptance(out2)
+    acc2 = out2["acceptance"]
+    assert "adag_vs_sync" not in out2
+    assert acc2["adag_vs_sync_ok"] is None and acc2["inproc_vs_sync_ok"] is None
+    assert acc2["per_window_speedup_ok"] is False  # 500ms > 421.15/5
+    assert acc2["final_loss_parity"] is None
 
 
 @pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it

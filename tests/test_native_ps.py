@@ -158,6 +158,60 @@ def test_native_int8_commits_match_python_hub():
         np.testing.assert_array_equal(n, p)
 
 
+def test_native_pull_commit_direct_matches_python_hub():
+    """The C++ hub's inproc pair (dk_ps_pull/dk_ps_commit) must move the
+    center exactly like the Python hub's pull_direct/commit_direct —
+    same deltas, same clocks, bit-equal centers."""
+    from distkeras_tpu.runtime.parameter_server import DynSGDParameterServer
+
+    rng = np.random.default_rng(7)
+    deltas = [[rng.normal(size=(2, 2)).astype(np.float32),
+               rng.normal(size=(3,)).astype(np.float32)] for _ in range(5)]
+
+    def drive(ps):
+        weights, clock = ps.pull_direct()
+        assert clock == 0
+        for i, d in enumerate(deltas):
+            # commit against a deliberately stale clock every other step so
+            # the DynSGD scaling path is exercised through both hubs
+            ps.commit_direct(d, clock if i % 2 == 0 else max(clock - 1, 0))
+            weights, clock = ps.pull_direct()
+        assert clock == len(deltas) == ps.num_updates
+        return weights
+
+    w_native = drive(NativeParameterServer(_weights(), mode=MODE_DYNSGD))
+    w_python = drive(DynSGDParameterServer(_weights()))
+    for n, p in zip(w_native, w_python):
+        np.testing.assert_array_equal(n, p)
+
+
+def test_native_inproc_trainer_matches_python_inproc(toy_dataset):
+    """transport='inproc' against the C++ hub: same trajectory as the
+    Python hub inproc run (single worker, deterministic schedule)."""
+    import jax
+
+    from distkeras_tpu import AsyncDOWNPOUR
+    from distkeras_tpu.models.base import Model, ModelSpec
+
+    spec = ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+
+    def run(native):
+        tr = AsyncDOWNPOUR(Model.init(spec, seed=0),
+                           loss="categorical_crossentropy", batch_size=16,
+                           num_epoch=1, num_workers=1, communication_window=4,
+                           learning_rate=0.05, seed=0, transport="inproc",
+                           native_ps=native)
+        model = tr.train(toy_dataset)
+        return tr, model
+
+    t_n, m_n = run(True)
+    t_p, m_p = run(False)
+    assert t_n.history == t_p.history
+    for a, b in zip(jax.tree.leaves(m_n.params), jax.tree.leaves(m_p.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_native_async_downpour_trains_with_int8_commits(toy_dataset):
     """End-to-end: the C++ hub + int8 commits still train the toy task."""
     import distkeras_tpu as dk

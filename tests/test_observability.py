@@ -318,6 +318,16 @@ def test_async_adag_smoke_exports_metrics_and_chrome_trace(telemetry, toy_datase
     assert snap["counters"]["ps_commits_total"] > 0
     assert snap["counters"]["ps_pull_bytes_total"] > 0
     assert snap["counters"]["ps_commit_bytes_total"] > 0
+    # issue-3 client-side hot-path instruments (exported through the same
+    # registry the telemetry punchcard action snapshots)
+    assert snap["counters"]["ps.commit_bytes"] > 0
+    assert snap["histograms"]["ps.pull_latency_ms"]["count"] > 0
+    assert snap["histograms"]["ps.commit_latency_ms"]["count"] > 0
+    assert snap["histograms"]["ps.serialize_ms"]["count"] > 0
+    assert "ps.inflight_depth" in snap["gauges"]
+    # hub-side staleness distribution: one observation per applied commit
+    assert snap["histograms"]["ps_commit_staleness"]["count"] \
+        == snap["counters"]["ps_commits_total"]
     wall = snap["histograms"]["async_window_wall_seconds"]
     dev = snap["histograms"]["async_window_device_seconds"]
     assert wall["count"] >= 3 and dev["count"] >= 3

@@ -46,6 +46,109 @@ def test_json_frames_over_socketpair():
         b.close()
 
 
+# -- zero-copy flat framing (issue 3) -----------------------------------------
+
+def _codec_templates():
+    return [np.zeros((2, 3), np.float32), np.zeros((5,), np.float32)]
+
+
+def test_flat_codec_wire_bytes_match_generic_encoder():
+    """The codec's frame must be byte-identical to encode_tensors' — the
+    C++ hub and generic peers parse one layout."""
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.linspace(0, 1, 5).astype(np.float32)]
+    codec = net.FlatFrameCodec(_codec_templates())
+    a, b = socket.socketpair()
+    try:
+        codec.send(a, net.ACTION_COMMIT, arrays)
+        frame = net._recv_exact(b, codec.frame_len)
+        generic = net.encode_tensors(net.ACTION_COMMIT, arrays)
+        assert frame == len(generic).to_bytes(8, "big") + generic
+    finally:
+        a.close()
+        b.close()
+
+
+def test_flat_codec_interops_both_directions():
+    """codec -> generic decode AND generic send -> codec scatter-receive."""
+    tmpl = _codec_templates()
+    codec = net.FlatFrameCodec(tmpl)
+    arrays = [np.full((2, 3), 2.5, np.float32), np.arange(5, dtype=np.float32)]
+    a, b = socket.socketpair()
+    try:
+        codec.send(a, net.ACTION_WEIGHTS, arrays)
+        action, got = net.recv_tensors(b, templates=tmpl)
+        assert action == net.ACTION_WEIGHTS
+        for g, want in zip(got, arrays):
+            np.testing.assert_array_equal(g, want)
+
+        net.send_tensors(a, net.ACTION_WEIGHTS, arrays)
+        out = [np.empty_like(t) for t in tmpl]
+        action = codec.recv_into(b, out)
+        assert action == net.ACTION_WEIGHTS
+        for g, want in zip(out, arrays):
+            np.testing.assert_array_equal(g, want)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_flat_codec_rejects_schema_mismatch():
+    tmpl = _codec_templates()
+    codec = net.FlatFrameCodec(tmpl)
+    a, b = socket.socketpair()
+    try:
+        # wrong tensor count on the wire -> frame size mismatch
+        net.send_tensors(a, net.ACTION_WEIGHTS, [np.zeros((2, 3), np.float32)])
+        with pytest.raises(ValueError, match="does not match"):
+            codec.recv_into(b, [np.empty_like(t) for t in tmpl])
+        # wrong dtype/size at pack time
+        with pytest.raises(ValueError, match="does not match"):
+            codec.pack(net.ACTION_COMMIT,
+                       [np.zeros((2, 3), np.float64), np.zeros((5,), np.float32)])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_tensors_decodes_into_preallocated_out():
+    """Satellite: templates/out decode straight into caller arrays — the
+    returned arrays ARE the preallocated ones, no intermediate copies."""
+    tmpl = _codec_templates()
+    arrays = [np.full((2, 3), 4.0, np.float32), np.arange(5, dtype=np.float32)]
+    a, b = socket.socketpair()
+    try:
+        net.send_tensors(a, net.ACTION_WEIGHTS, arrays)
+        pre = [np.zeros_like(t) for t in tmpl]
+        action, got = net.recv_tensors(b, out=pre)
+        assert action == net.ACTION_WEIGHTS
+        assert got[0] is pre[0] and got[1] is pre[1]
+        for g, want in zip(pre, arrays):
+            np.testing.assert_array_equal(g, want)
+        # the template-less control-plane path still returns raw uint8
+        net.send_tensors(a, net.ACTION_COMMIT, [np.zeros(3, np.float32)])
+        action, blobs = net.recv_tensors(b)
+        assert action == net.ACTION_COMMIT and blobs[0].dtype == np.uint8
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_into_reuses_buffer_and_views():
+    a, b = socket.socketpair()
+    try:
+        buf = bytearray()
+        net.send_frame(a, b"x" * 32)
+        mv = net.recv_frame_into(b, buf)
+        assert bytes(mv) == b"x" * 32 and len(buf) == 32
+        net.send_frame(a, b"y" * 8)  # smaller frame: buffer NOT shrunk
+        mv = net.recv_frame_into(b, buf)
+        assert bytes(mv) == b"y" * 8 and len(buf) == 32
+    finally:
+        a.close()
+        b.close()
+
+
 # -- parameter servers --------------------------------------------------------
 
 def _weights():
@@ -135,6 +238,108 @@ def test_client_size_mismatch_raises():
         c.sock.close()
     finally:
         ps.stop()
+
+
+def test_ps_stop_wakes_accept_thread_immediately():
+    """stop() must shutdown() the listener (close() alone does not wake a
+    blocked accept() on Linux): before the fix every hub stop burned the
+    full 5s join timeout and leaked its accept thread."""
+    import time as _time
+
+    ps = DeltaParameterServer(_weights())
+    ps.start()
+    t0 = _time.monotonic()
+    ps.stop()
+    assert _time.monotonic() - t0 < 2.0, "stop() waited on the accept thread"
+    assert not ps._accept_thread.is_alive()
+
+
+def test_pipelined_client_coalesces_acks_and_prefetches():
+    """The issue-3 hot-path schedule, driven by hand: prefetch pull k+1
+    BEFORE commit k, consume replies lazily — every commit still lands,
+    every prefetched pull observes the center WITHOUT the commit sent
+    after it (self-staleness 1), and drain() leaves nothing in flight."""
+    ps = DeltaParameterServer([np.zeros((4,), np.float32)])
+    ps.start()
+    tmpl = [np.zeros((4,), np.float32)]
+    one = [np.ones((4,), np.float32)]
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=tmpl) as c:
+            w0 = c.pull()
+            np.testing.assert_array_equal(w0[0], 0)
+            for k in range(4):
+                c.pull_nowait()        # prefetch (k+1) — predates commit k
+                c.commit_nowait(one)   # fire-and-forget
+                # deadlock-avoidance contract: the commit send claimed the
+                # in-flight weights reply FIRST (the hub must be parked in
+                # recv while the commit bytes travel), so no weights reply
+                # remains pending once commit_nowait returns
+                assert all(kind != net.ACTION_WEIGHTS
+                           for kind, _ in c._pending)
+                w = c.wait_weights()   # hands out the claimed pull
+                # the prefetched snapshot misses THIS window's commit
+                np.testing.assert_array_equal(w[0], np.full(4, float(k)))
+            c.drain()
+            assert len(c._pending) == 0
+            np.testing.assert_array_equal(c.pull()[0], np.full(4, 4.0))
+        assert ps.num_updates == 4
+    finally:
+        ps.stop()
+
+
+def test_pipelined_pull_buffers_double_buffer():
+    """wait_weights alternates between two landing buffers, so the pull
+    being consumed survives the next prefetched receive (and exactly one
+    more)."""
+    ps = DeltaParameterServer([np.zeros((4,), np.float32)])
+    ps.start()
+    tmpl = [np.zeros((4,), np.float32)]
+    try:
+        with PSClient("127.0.0.1", ps.port, templates=tmpl) as c:
+            w1 = c.pull()
+            c.commit([np.ones((4,), np.float32)])
+            w2 = c.pull()
+            assert w1[0] is not w2[0]  # different landing buffers
+            np.testing.assert_array_equal(w1[0], 0)  # older pull intact
+            np.testing.assert_array_equal(w2[0], 1)
+            c.commit([np.ones((4,), np.float32)])
+            w3 = c.pull()  # reuses w1's buffer
+            assert w3[0] is w1[0]
+            np.testing.assert_array_equal(w3[0], 2)
+    finally:
+        ps.stop()
+
+
+def test_ps_killed_mid_run_surfaces_clean_error_no_hang():
+    """Fault-injection satellite: the hub dies while a worker is mid
+    pull/commit traffic — PSClient must surface ConnectionError/OSError
+    promptly (no hang on a half-read frame, no silent corruption)."""
+    import time as _time
+
+    ps = DeltaParameterServer([np.zeros((1 << 16,), np.float32)])
+    ps.start()
+    tmpl = [np.zeros((1 << 16,), np.float32)]
+    c = PSClient("127.0.0.1", ps.port, templates=tmpl, timeout=10.0)
+    c.pull()
+    c.commit([np.ones((1 << 16,), np.float32)])  # connection is known-good
+    stopper = threading.Thread(target=ps.stop)
+    deadline = _time.monotonic() + 30.0
+    stopper.start()
+    try:
+        with pytest.raises((ConnectionError, OSError, ValueError)):
+            while _time.monotonic() < deadline:
+                c.pull_nowait()
+                c.commit_nowait([np.ones((1 << 16,), np.float32)])
+                c.wait_weights()
+        assert _time.monotonic() < deadline, "client hung on a dead hub"
+    finally:
+        stopper.join()
+        c.sock.close()
+    # the center survived to the last APPLIED commit — an interrupted
+    # frame must never half-apply
+    applied = ps.get_weights()[0]
+    assert float(applied[0]) == float(applied[-1])
+    assert float(applied[0]) == ps.num_updates
 
 
 # -- async trainers -----------------------------------------------------------

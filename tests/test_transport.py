@@ -1,0 +1,139 @@
+"""Transport-parity tests (issue 3): ``transport="inproc"`` and
+``transport="socket"`` must produce IDENTICAL training trajectories.
+
+The inproc client executes its pull/commit at the exact program points the
+socket client *sends* at, and both paths run the same center arithmetic
+under the same hub lock — so for a deterministic schedule (one worker) the
+trajectories are bit-equal, pipelined or serial, compressed or not.  These
+tests pin that property; if it breaks, the inproc fast path has silently
+become a different algorithm.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import observability as obs
+from distkeras_tpu.models.base import Model, ModelSpec
+
+
+def _mlp_spec():
+    return ModelSpec(name="mlp", config={"hidden_sizes": (16,), "num_outputs": 2},
+                     input_shape=(8,))
+
+
+def _train(trainer_name, toy_dataset, *, transport, pipeline, num_workers=1,
+           **extra):
+    import distkeras_tpu as dk
+
+    cls = getattr(dk, trainer_name)
+    trainer = cls(Model.init(_mlp_spec(), seed=0),
+                  loss="categorical_crossentropy", batch_size=16, num_epoch=2,
+                  num_workers=num_workers, communication_window=4,
+                  learning_rate=0.05, seed=0, transport=transport,
+                  pipeline=pipeline, **extra)
+    model = trainer.train(toy_dataset)
+    return trainer, model
+
+
+def _assert_bit_identical(run_a, run_b):
+    import jax
+
+    (tr_a, m_a), (tr_b, m_b) = run_a, run_b
+    assert tr_a.history == tr_b.history, "window-loss trajectories diverged"
+    for a, b in zip(jax.tree.leaves(m_a.params), jax.tree.leaves(m_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("trainer_name,pipeline,extra", [
+    ("AsyncADAG", True, {}),
+    ("AsyncADAG", False, {}),
+    pytest.param("AsyncAEASGD", True, {"rho": 2.0}, marks=pytest.mark.slow),
+])
+def test_inproc_matches_socket_bit_identical(trainer_name, pipeline, extra,
+                                             toy_dataset):
+    """Single-worker ADAG/AEASGD trajectories are bit-equal across
+    transports, with and without the pipelined overlap."""
+    sock = _train(trainer_name, toy_dataset, transport="socket",
+                  pipeline=pipeline, **extra)
+    inproc = _train(trainer_name, toy_dataset, transport="inproc",
+                    pipeline=pipeline, **extra)
+    _assert_bit_identical(sock, inproc)
+
+
+@pytest.mark.slow  # full-suite coverage; tier-1 keeps the f32 parity pins
+def test_inproc_matches_socket_with_int8_commits(toy_dataset):
+    """The inproc client round-trips commits through the SAME quantize/
+    dequantize + error-feedback math the wire uses, so compressed runs
+    stay trajectory-identical too."""
+    sock = _train("AsyncADAG", toy_dataset, transport="socket", pipeline=True,
+                  compress_commits="int8")
+    inproc = _train("AsyncADAG", toy_dataset, transport="inproc", pipeline=True,
+                    compress_commits="int8")
+    _assert_bit_identical(sock, inproc)
+
+
+@pytest.mark.slow  # full-suite coverage; tier-1 keeps the f32 parity pins
+def test_inproc_multiworker_learns(toy_dataset):
+    """inproc with real worker concurrency end to end: 4 workers race
+    commit_direct under the hub lock and the center still learns."""
+    from distkeras_tpu.data.transformers import LabelIndexTransformer
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.predictors import ModelPredictor
+
+    trainer, model = _train("AsyncADAG", toy_dataset, transport="inproc",
+                            pipeline=True, num_workers=4)
+    assert trainer.parameter_server.num_updates > 0
+    ds = ModelPredictor(model, features_col="features").predict(toy_dataset)
+    ds = LabelIndexTransformer().transform(ds)
+    acc = AccuracyEvaluator(prediction_col="prediction_index",
+                            label_col="label_index").evaluate(ds)
+    assert acc > 0.9, f"inproc AsyncADAG accuracy {acc}"
+
+
+def test_inproc_rejects_worker_only_mode():
+    import distkeras_tpu as dk
+
+    with pytest.raises(ValueError, match="inproc"):
+        dk.AsyncADAG(_mlp_spec(), transport="inproc",
+                     ps_address=("head", 4242))
+    with pytest.raises(ValueError, match="transport"):
+        dk.AsyncADAG(_mlp_spec(), transport="carrier-pigeon")
+
+
+def test_pipelined_prefetch_semantics_and_staleness_accounting(toy_dataset):
+    """Pipelining's documented semantics (ARCHITECTURE.md "Async
+    transport"): the pull for window k+1 is issued BEFORE commit k, so the
+    worker trains k+1 from a center missing its own commit k — a genuinely
+    staler schedule than serial (the trajectories must differ).  The hub's
+    clock staleness, measured from the most recent pull REQUEST on the
+    connection, still reads 0 for a lone worker in BOTH modes — it
+    undercounts the delta's true base by the prefetch depth, which is why
+    exact-staleness consumers (DynSGD scaling studies) use
+    ``pipeline=False``."""
+    obs.reset()
+    obs.enable()
+    try:
+        piped, _ = _train("AsyncADAG", toy_dataset, transport="inproc",
+                          pipeline=True)
+        hist = obs.snapshot()["histograms"]["ps_commit_staleness"]
+        assert hist["count"] == len(piped.history)
+        # every window's prefetch re-arms the connection clock before its
+        # commit -> measured 0; only the FINAL window (which has nothing
+        # left to prefetch) commits against its window-start pull -> 1,
+        # the one commit whose measurement equals the true delta base
+        assert hist["sum"] == 1.0 and hist["max"] == 1.0
+
+        obs.reset()
+        serial, _ = _train("AsyncADAG", toy_dataset, transport="inproc",
+                           pipeline=False)
+        hist = obs.snapshot()["histograms"]["ps_commit_staleness"]
+        assert hist["count"] == len(serial.history)
+        assert hist["sum"] == 0  # serial: every pull reflects every commit
+    finally:
+        obs.disable()
+        obs.reset()
+    # same windows, different schedule: the prefetched pulls make the
+    # pipelined trajectory diverge from the serial one after window 0
+    assert len(piped.history) == len(serial.history)
+    assert piped.history[0] == serial.history[0]
+    assert piped.history != serial.history
