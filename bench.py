@@ -1494,6 +1494,173 @@ def _async_acceptance(out: dict) -> None:
     }
 
 
+def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256,
+                          windows_per_epoch: int = 8, epochs: int = 3):
+    """Issue-4 recovery leg: how the async stack behaves when its wires and
+    workers actually fail.
+
+    Three sub-legs on the same workload (AsyncADAG, the headline async
+    config):
+
+    - ``fault_free``: warm reference run — the loss/wall denominator.
+    - ``sever``: an external hub behind a :class:`ChaosProxy` whose seeded
+      plan severs each worker's connection once mid-run; workers reconnect
+      with backoff (``max_reconnects``) and finish.  Records the
+      reconnect count and the ``ps.reconnect_ms`` time-to-recover
+      histogram (telemetry), plus final-loss parity vs fault-free.  Cold
+      timing: a warm-up run would consume the proxy's connection ordinals
+      and defuse the plan, so wall here includes compile and is NOT
+      comparable to the fault-free leg — recovery time comes from the
+      telemetry histogram, not the wall clock.
+    - ``worker_restart``: a seeded :class:`WorkerKillPlan` kills one worker
+      mid-window; the supervisor (``on_worker_failure="restart"``)
+      restarts it from the hub's center.
+
+    Each sub-leg is individually fallible (error recorded, not fatal) and
+    the acceptance block degrades to ``None`` for any tripwire whose
+    denominator leg failed — PR 3's convention."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import mnist_cnn_spec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+    from distkeras_tpu.runtime.faults import ChaosProxy, Fault, FaultPlan, WorkerKillPlan
+    from distkeras_tpu.runtime.launcher import start_parameter_server
+
+    spec = mnist_cnn_spec()
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    ds = Dataset({
+        "features": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+        "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)],
+    })
+    kwargs = dict(loss="categorical_crossentropy", batch_size=batch,
+                  num_epoch=epochs, learning_rate=0.01, seed=0,
+                  num_workers=workers, communication_window=window)
+    out = {"workers": workers, "window": window, "batch": batch,
+           "epochs": epochs}
+
+    def final_loss(tr):
+        return (round(float(np.mean(tr.history[-8:])), 6)
+                if tr.history else None)
+
+    try:
+        tr = AsyncADAG(Model.init(spec, seed=0), **kwargs)
+        tr.train(ds, shuffle=False)  # compile + warm
+        tr.model = Model.init(spec, seed=0)
+        tr.history = []
+        t0 = time.perf_counter()
+        tr.train(ds, shuffle=False)
+        out["fault_free"] = {"wall_s": round(time.perf_counter() - t0, 3),
+                             "final_loss": final_loss(tr)}
+    except Exception as ex:
+        out["fault_free"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    try:
+        model0 = Model.init(spec, seed=0)
+        ps = start_parameter_server(model0, mode="adag", num_workers=workers,
+                                    idle_timeout=120.0)
+        # one sever per worker, at distinct established-pipeline frames —
+        # explicit plan (not .random) so the bench exercises exactly one
+        # recovery per worker every run
+        plan = FaultPlan([Fault(conn=i, direction="s2c", frame=4 + 3 * i,
+                                kind="sever") for i in range(workers)])
+        try:
+            with ChaosProxy("127.0.0.1", ps.port, plan) as proxy:
+                tr2 = AsyncADAG(Model.init(spec, seed=0),
+                                ps_address=("127.0.0.1", proxy.port),
+                                max_reconnects=8, reconnect_backoff=0.05,
+                                **kwargs)
+                obs.enable()
+                obs.reset()
+                try:
+                    t0 = time.perf_counter()
+                    tr2.train(ds, shuffle=False)
+                    wall = time.perf_counter() - t0
+                    snap = obs.snapshot()
+                finally:
+                    obs.reset()
+                    obs.disable()
+                fired = len(proxy.faults_fired)
+        finally:
+            ps.stop()
+        rec = (snap.get("histograms", {}).get("ps.reconnect_ms") or {})
+        out["sever"] = {
+            "timing": "cold-wall (includes compile; see docstring)",
+            "wall_s": round(wall, 3),
+            "final_loss": final_loss(tr2),
+            "faults_fired": fired,
+            "reconnects": snap.get("counters", {}).get("ps.reconnects", 0.0),
+            "recovery_ms": {"count": rec.get("count"),
+                            "mean": rec.get("mean"),
+                            "max": rec.get("max")},
+        }
+    except Exception as ex:
+        out["sever"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    try:
+        kill_plan = WorkerKillPlan([(workers - 1, windows_per_epoch // 2)],
+                                   seed=4)
+        tr3 = AsyncADAG(Model.init(spec, seed=0),
+                        on_worker_failure="restart", max_worker_restarts=2,
+                        fault_hook=kill_plan.hook, **kwargs)
+        t0 = time.perf_counter()
+        tr3.train(ds, shuffle=False)
+        out["worker_restart"] = {
+            "timing": "cold-wall",
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "final_loss": final_loss(tr3),
+            "kills_fired": len(kill_plan.fired),
+            "restarts": tr3.worker_restarts,
+            "worker_errors": len(tr3.worker_errors),
+        }
+    except Exception as ex:
+        out["worker_restart"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    _async_recovery_acceptance(out)
+    return out
+
+
+def _async_recovery_acceptance(out: dict) -> None:
+    """Attach the issue-4 recovery tripwires, in place.  Booleans, or None
+    when a denominator leg is missing/errored (graceful degradation,
+    matching ``_async_acceptance``): recovery must COMPLETE (every planned
+    fault fired, every reconnect/restart succeeded, the run finished) and
+    the recovered trajectory must LAND where the fault-free one does."""
+    def _ok(name):
+        return isinstance(out.get(name), dict) and "error" not in out[name]
+
+    ff_loss = out["fault_free"].get("final_loss") if _ok("fault_free") else None
+
+    def parity(leg):
+        loss = out[leg].get("final_loss") if _ok(leg) else None
+        if loss is None or ff_loss is None:
+            return None, None
+        tol = max(0.05, 0.15 * abs(ff_loss))
+        return round(abs(loss - ff_loss), 6), tol
+
+    sever_diff, sever_tol = parity("sever")
+    restart_diff, restart_tol = parity("worker_restart")
+    out["acceptance"] = {
+        "sever_recovered_ok": (bool(out["sever"]["faults_fired"] >= 1
+                                    and out["sever"]["reconnects"] >= 1)
+                               if _ok("sever") else None),
+        "sever_loss_abs_diff": sever_diff,
+        "sever_loss_tol": sever_tol,
+        "sever_loss_parity_ok": (None if sever_diff is None
+                                 else bool(sever_diff <= sever_tol)),
+        "worker_restart_ok": (bool(out["worker_restart"]["restarts"] >= 1
+                                   and out["worker_restart"]["worker_errors"] == 0)
+                              if _ok("worker_restart") else None),
+        "restart_loss_abs_diff": restart_diff,
+        "restart_loss_tol": restart_tol,
+        "restart_loss_parity_ok": (None if restart_diff is None
+                                   else bool(restart_diff <= restart_tol)),
+    }
+
+
 def _leg_ratio(current: float, base: float):
     """current/base rounded, or None when either side is missing/zero."""
     if not current or not base:
@@ -1714,6 +1881,11 @@ def main() -> None:
                 out["async"] = _bench_async()
             except Exception as e:
                 out["async"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["async_recovery"] = _bench_async_recovery()
+            except Exception as e:
+                out["async_recovery"] = {"error": f"{type(e).__name__}: {e}"}
             _apply_leg_baselines(out, baseline)
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,

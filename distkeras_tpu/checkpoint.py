@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import warnings
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -150,11 +151,38 @@ class Checkpointer:
 
     def restore(self, templates: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
         """Restore named pytrees at ``step`` (default: latest).  ``templates``
-        maps tree name -> structure/shape template."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        maps tree name -> structure/shape template.
+
+        With ``step=None``, a corrupt or partial latest checkpoint (torn by
+        something the atomic rename can't defend against — disk
+        truncation, a partial copy from another machine) is SKIPPED with a
+        warning and the next older one is tried; only when no checkpoint
+        is readable does the call raise.  An explicitly requested ``step``
+        always raises on corruption — the caller named it, silently
+        substituting a different state would be worse than failing."""
+        if step is not None:
+            return self._restore_at(step, templates)
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                return self._restore_at(s, templates)
+            except Exception as e:
+                last_err = e
+                warnings.warn(f"skipping corrupt/unreadable checkpoint "
+                              f"step {s}: {type(e).__name__}: {e}")
+        # carry the last underlying error in the MESSAGE too: when every
+        # step fails for the same non-corruption reason (e.g. a template
+        # mismatch after a model-format change), the cause must be in the
+        # caller's face, not only in the warning stream / __cause__
+        raise FileNotFoundError(
+            f"no readable checkpoint in {self.directory} "
+            f"({len(steps)} present, all corrupt or unreadable; last error: "
+            f"{type(last_err).__name__}: {last_err})") from last_err
+
+    def _restore_at(self, step: int, templates: Dict[str, Any]) -> Dict[str, Any]:
         d = self._step_dir(step)
         with open(os.path.join(d, "checkpoint.json")) as f:
             meta = json.load(f)

@@ -16,8 +16,16 @@ Two interchangeable parameter-server hubs speak one wire protocol:
   the GIL, so concurrent workers do not serialize on the interpreter.
 """
 
+from distkeras_tpu.runtime.faults import (  # noqa: F401
+    ChaosProxy,
+    Fault,
+    FaultPlan,
+    InjectedWorkerFault,
+    WorkerKillPlan,
+)
 from distkeras_tpu.runtime.networking import (  # noqa: F401
     FlatFrameCodec,
+    ProtocolError,
     configure_socket,
     connect,
     determine_host_address,
@@ -33,6 +41,7 @@ from distkeras_tpu.runtime.parameter_server import (  # noqa: F401
     ADAGParameterServer,
     DeltaParameterServer,
     DynSGDParameterServer,
+    HubSnapshotter,
     InprocPSClient,
     PSClient,
     SocketParameterServer,

@@ -104,11 +104,17 @@ class AsyncDistributedTrainer(Trainer):
                  ps_address: Optional[Tuple[str, int]] = None,
                  checkpoint_interval: float = 30.0,
                  on_worker_failure: str = "raise",
+                 max_worker_restarts: int = 2,
                  fault_hook: Optional[Callable[[int, int], None]] = None,
                  compress_commits: Optional[str] = None,
                  transport: str = "socket",
                  pipeline: bool = True,
                  max_inflight_commits: int = 2,
+                 max_reconnects: Optional[int] = None,
+                 reconnect_backoff: float = 0.1,
+                 heartbeat_interval: Optional[float] = None,
+                 elastic: bool = False,
+                 ps_idle_timeout: Optional[float] = None,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -155,14 +161,48 @@ class AsyncDistributedTrainer(Trainer):
         # first worker error after all workers drain; "continue" lets the
         # survivors finish and returns the center anyway, recording errors
         # in self.worker_errors — the hub-keeps-serving recovery mode.
-        if on_worker_failure not in ("raise", "continue"):
-            raise ValueError(f"on_worker_failure must be 'raise' or 'continue', "
-                             f"got {on_worker_failure!r}")
+        # "restart" is Spark's re-run made explicit and bounded: a crashed
+        # worker is restarted up to max_worker_restarts times from the
+        # hub's CURRENT center (its progress up to the last applied commit
+        # survives in the center; its local divergence does not), resuming
+        # at the epoch it died in; once the budget is exhausted the error
+        # is recorded and the survivors finish, as with "continue".
+        if on_worker_failure not in ("raise", "continue", "restart"):
+            raise ValueError(f"on_worker_failure must be 'raise', 'continue' "
+                             f"or 'restart', got {on_worker_failure!r}")
         self.on_worker_failure = on_worker_failure
+        self.max_worker_restarts = int(max_worker_restarts)
+        # client resilience knobs, threaded into every worker's PSClient
+        # (socket transport only — inproc workers share the hub's process
+        # and die with it): bounded reconnect with exponential backoff +
+        # jitter, and heartbeat-on-idle against the hub's idle eviction.
+        # Default: worker-only mode (ps_address) gets a small budget —
+        # remote workers face real networks AND the standalone hub's
+        # default idle eviction, and a reconnect+re-pull is semantically
+        # safe — while a trainer that owns its hub fails fast (the hub
+        # dying means this process is dying with it)
+        if max_reconnects is None:
+            max_reconnects = 5 if ps_address is not None else 0
+        self.max_reconnects = int(max_reconnects)
+        self.reconnect_backoff = float(reconnect_backoff)
+        self.heartbeat_interval = heartbeat_interval
+        # elastic=True: the hub normalizes by LIVE membership instead of
+        # the configured worker count (ADAG; see ADAGParameterServer) —
+        # a permanently dead worker stops diluting the survivors
+        self.elastic = bool(elastic)
+        # half-open-connection eviction window on the trainer-owned hub.
+        # Default OFF: a trainer-owned hub only serves same-process
+        # workers, whose sockets always deliver FIN on death (true
+        # half-open needs a dead remote host/NIC), and a default eviction
+        # window would regress runs whose first-window compile outlasts
+        # it.  Standalone hubs (distkeras-ps / start_parameter_server)
+        # default to 300 s — they face real networks
+        self.ps_idle_timeout = ps_idle_timeout
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
         self.worker_errors: List[BaseException] = []
+        self.worker_restarts = 0  # total supervisor restarts, last train()
         self.parameter_server: Optional[Any] = None
         self._window_fn: Optional[Callable] = None  # cached per instance so a
         # second train() on the same trainer reuses the compiled program
@@ -171,6 +211,11 @@ class AsyncDistributedTrainer(Trainer):
     # -- factories (reference: allocate_worker / allocate_parameter_server) ---
     def allocate_parameter_server(self, weights: List[np.ndarray]) -> Any:
         raise NotImplementedError  # pragma: no cover - interface
+
+    def _hub_kwargs(self) -> dict:
+        """Fault-tolerance kwargs every trainer-owned hub (Python or C++)
+        takes; subclass allocators splat this into their constructor."""
+        return {"idle_timeout": self.ps_idle_timeout}
 
     # -- the algorithm's window-boundary math, ON DEVICE -----------------------
     # Both hooks take parameter PYTREES already resident on the worker's
@@ -213,8 +258,17 @@ class AsyncDistributedTrainer(Trainer):
 
     def _snapshot_loop(self, checkpointer, stop: threading.Event, get_center,
                        treedef, next_step: List[int], lock: threading.Lock) -> None:
+        import warnings
+
         while not stop.wait(self.checkpoint_interval):
-            self._snapshot(checkpointer, get_center, treedef, next_step, lock)
+            try:
+                self._snapshot(checkpointer, get_center, treedef, next_step, lock)
+            except Exception as e:
+                # a transient failure (hub mid-restart, disk hiccup) must
+                # not silently kill the snapshot thread for the rest of
+                # the run — skip this interval and try again
+                warnings.warn(f"center snapshot failed (will retry): "
+                              f"{type(e).__name__}: {e}")
 
     def _snapshot(self, checkpointer, get_center, treedef, next_step: List[int],
                   lock: threading.Lock) -> None:
@@ -293,116 +347,166 @@ class AsyncDistributedTrainer(Trainer):
         m_started = obs.counter("async_workers_started_total")
         m_finished = obs.counter("async_workers_finished_total")
 
+        restart_counts = [0] * self.num_workers
+
+        def worker_once(idx: int, start_epoch: int, progress: List[int],
+                        losses: List[Any]) -> None:
+            """One attempt at a worker's epoch loop, starting at
+            ``start_epoch``.  ``progress[0]`` tracks the epoch currently
+            being trained so the supervisor can resume a restarted worker
+            there (windows already committed within the interrupted epoch
+            replay — async SGD tolerates re-applied windows far better
+            than skipped data); ``progress[1]`` records ``len(losses)``
+            at that epoch's start so the supervisor can drop the aborted
+            attempt's partial-epoch losses before the replay re-records
+            them (history must not double-count replayed windows)."""
+            device = devices[idx % len(devices)]
+            if self.transport == "inproc":
+                client = InprocPSClient(ps, templates=flat0,
+                                        compress=self.compress_commits)
+            else:
+                client = PSClient(ps_host, ps_port, templates=flat0,
+                                  compress=self.compress_commits,
+                                  max_inflight=self.max_inflight_commits,
+                                  max_reconnects=self.max_reconnects,
+                                  reconnect_backoff=self.reconnect_backoff,
+                                  heartbeat_interval=self.heartbeat_interval)
+            pipeline = self.pipeline
+            try:
+                shard = dataset.shard(self.num_workers, idx)
+                # worker state lives on the device for the whole run;
+                # each window touches the host only for the PS wire
+                # exchange (pull in, commit out) and the feed slices.
+                # np.array: the socket client's pull buffers are reused
+                # by later prefetches, and params must own its storage.
+                # On a restart this pull IS the recovery point: the
+                # worker resumes from the hub's current center
+                params = jax.device_put(
+                    unflatten([np.array(w) for w in client.pull()]), device)
+                opt_state = jax.device_put(self.optimizer.init(params), device)
+                # one pull rides ahead of the window being computed (set
+                # when the previous window prefetched this window's pull)
+                pull_pending = False
+                for epoch in range(start_epoch, self.num_epoch):
+                    progress[0] = epoch
+                    progress[1] = len(losses)
+                    ds = shard.shuffle(seed=self.seed + 1000 * idx + epoch) if shuffle else shard
+                    stacked = ds.stacked_epoch(self.batch_size,
+                                               [self.features_col, self.label_col],
+                                               window=self.communication_window)
+                    xs, ys = stacked[self.features_col], stacked[self.label_col]
+                    n_windows = xs.shape[0]
+                    # with telemetry ON, window slices ride the shared
+                    # feed machinery with a no-op place: the producer
+                    # thread stages (wx, wy) views one window ahead and
+                    # records the feed queue gauges, while the device
+                    # transfer itself STAYS fused with the pull below —
+                    # one batched H2D per window.  With telemetry off the
+                    # loop is the plain zero-thread slice walk (no queue
+                    # handoff on the hot path)
+                    slices = ((xs[w], ys[w]) for w in range(xs.shape[0]))
+                    feed = (prefetch_to_device(slices, lambda s: s,
+                                               metric_prefix="async_feed")
+                            if obs.enabled() else slices)
+                    for w, (wx_h, wy_h) in enumerate(feed):
+                        if self.fault_hook is not None:
+                            self.fault_hook(idx, w)
+                        telemetry = obs.enabled()
+                        t_wall = time.perf_counter() if telemetry else 0.0
+                        with obs.span("async.window", worker=idx,
+                                      epoch=epoch, window=w):
+                            if not pull_pending:
+                                client.pull_nowait()
+                            pulled_host = client.wait_weights()
+                            pull_pending = False
+                            # ONE batched H2D per window (center + feed
+                            # slices) — on a relayed device every transfer
+                            # call is a host round trip, so they are fused
+                            pulled, wx, wy = jax.device_put(
+                                (unflatten(pulled_host), wx_h, wy_h), device)
+                            t_dev = time.perf_counter() if telemetry else 0.0
+                            params, opt_state, commit, mloss = window_fn(
+                                params, opt_state, pulled, wx, wy)
+                            # prefetch the NEXT window's pull while this
+                            # window's program runs: the request leaves
+                            # now (jax dispatch is async) and the weights
+                            # stream into the other landing buffer under
+                            # the compute — the center it snapshots
+                            # predates this window's commit below
+                            # (self-staleness 1; ARCHITECTURE.md)
+                            last_window = (w == n_windows - 1
+                                           and epoch == self.num_epoch - 1)
+                            if pipeline and not last_window:
+                                client.pull_nowait()
+                                pull_pending = True
+                            if telemetry:
+                                # block on the window program ONLY when
+                                # measuring: dispatch-to-completion is
+                                # the device leg of the wall/device
+                                # decomposition (the commit d2h below
+                                # would serialize on it anyway)
+                                jax.block_until_ready(mloss)
+                                m_dev.observe(time.perf_counter() - t_dev)
+                            # one batched D2H for the payload; leaf order is
+                            # the same tree.flatten order as the templates
+                            payload = jax.tree.leaves(jax.device_get(commit))
+                            if pipeline:
+                                # fire-and-forget: the ack coalesces into
+                                # the next window's weights receive
+                                client.commit_nowait(payload)
+                            else:
+                                client.commit(payload)
+                        if telemetry:
+                            m_wall.observe(time.perf_counter() - t_wall)
+                            m_windows.inc()
+                        # loss stays a device scalar until the run ends:
+                        # float() here would add one more blocking round
+                        # trip per window
+                        losses.append(mloss)
+                # trailing acks (and nothing else: the last window never
+                # prefetches) — commits must be APPLIED before the run's
+                # final center read, not just queued on the wire
+                client.drain()
+            finally:
+                client.close()
         def run_worker(idx: int) -> None:
             losses: List[Any] = []
             start_counted = obs.enabled()
             if start_counted:
                 m_started.inc()
+            progress = [0, 0]  # [resume epoch, losses length at its start]
             try:
-                device = devices[idx % len(devices)]
-                if self.transport == "inproc":
-                    client = InprocPSClient(ps, templates=flat0,
-                                            compress=self.compress_commits)
-                else:
-                    client = PSClient(ps_host, ps_port, templates=flat0,
-                                      compress=self.compress_commits,
-                                      max_inflight=self.max_inflight_commits)
-                pipeline = self.pipeline
-                try:
-                    shard = dataset.shard(self.num_workers, idx)
-                    # worker state lives on the device for the whole run;
-                    # each window touches the host only for the PS wire
-                    # exchange (pull in, commit out) and the feed slices.
-                    # np.array: the socket client's pull buffers are reused
-                    # by later prefetches, and params must own its storage
-                    params = jax.device_put(
-                        unflatten([np.array(w) for w in client.pull()]), device)
-                    opt_state = jax.device_put(self.optimizer.init(params), device)
-                    # one pull rides ahead of the window being computed (set
-                    # when the previous window prefetched this window's pull)
-                    pull_pending = False
-                    for epoch in range(self.num_epoch):
-                        ds = shard.shuffle(seed=self.seed + 1000 * idx + epoch) if shuffle else shard
-                        stacked = ds.stacked_epoch(self.batch_size,
-                                                   [self.features_col, self.label_col],
-                                                   window=self.communication_window)
-                        xs, ys = stacked[self.features_col], stacked[self.label_col]
-                        n_windows = xs.shape[0]
-                        # with telemetry ON, window slices ride the shared
-                        # feed machinery with a no-op place: the producer
-                        # thread stages (wx, wy) views one window ahead and
-                        # records the feed queue gauges, while the device
-                        # transfer itself STAYS fused with the pull below —
-                        # one batched H2D per window.  With telemetry off the
-                        # loop is the plain zero-thread slice walk (no queue
-                        # handoff on the hot path)
-                        slices = ((xs[w], ys[w]) for w in range(xs.shape[0]))
-                        feed = (prefetch_to_device(slices, lambda s: s,
-                                                   metric_prefix="async_feed")
-                                if obs.enabled() else slices)
-                        for w, (wx_h, wy_h) in enumerate(feed):
-                            if self.fault_hook is not None:
-                                self.fault_hook(idx, w)
-                            telemetry = obs.enabled()
-                            t_wall = time.perf_counter() if telemetry else 0.0
-                            with obs.span("async.window", worker=idx,
-                                          epoch=epoch, window=w):
-                                if not pull_pending:
-                                    client.pull_nowait()
-                                pulled_host = client.wait_weights()
-                                pull_pending = False
-                                # ONE batched H2D per window (center + feed
-                                # slices) — on a relayed device every transfer
-                                # call is a host round trip, so they are fused
-                                pulled, wx, wy = jax.device_put(
-                                    (unflatten(pulled_host), wx_h, wy_h), device)
-                                t_dev = time.perf_counter() if telemetry else 0.0
-                                params, opt_state, commit, mloss = window_fn(
-                                    params, opt_state, pulled, wx, wy)
-                                # prefetch the NEXT window's pull while this
-                                # window's program runs: the request leaves
-                                # now (jax dispatch is async) and the weights
-                                # stream into the other landing buffer under
-                                # the compute — the center it snapshots
-                                # predates this window's commit below
-                                # (self-staleness 1; ARCHITECTURE.md)
-                                last_window = (w == n_windows - 1
-                                               and epoch == self.num_epoch - 1)
-                                if pipeline and not last_window:
-                                    client.pull_nowait()
-                                    pull_pending = True
-                                if telemetry:
-                                    # block on the window program ONLY when
-                                    # measuring: dispatch-to-completion is
-                                    # the device leg of the wall/device
-                                    # decomposition (the commit d2h below
-                                    # would serialize on it anyway)
-                                    jax.block_until_ready(mloss)
-                                    m_dev.observe(time.perf_counter() - t_dev)
-                                # one batched D2H for the payload; leaf order is
-                                # the same tree.flatten order as the templates
-                                payload = jax.tree.leaves(jax.device_get(commit))
-                                if pipeline:
-                                    # fire-and-forget: the ack coalesces into
-                                    # the next window's weights receive
-                                    client.commit_nowait(payload)
-                                else:
-                                    client.commit(payload)
-                            if telemetry:
-                                m_wall.observe(time.perf_counter() - t_wall)
-                                m_windows.inc()
-                            # loss stays a device scalar until the run ends:
-                            # float() here would add one more blocking round
-                            # trip per window
-                            losses.append(mloss)
-                    # trailing acks (and nothing else: the last window never
-                    # prefetches) — commits must be APPLIED before the run's
-                    # final center read, not just queued on the wire
-                    client.drain()
-                finally:
-                    client.close()
-            except BaseException as e:  # surface worker crashes to the driver
-                errors.append(e)
+                while True:
+                    try:
+                        worker_once(idx, progress[0], progress, losses)
+                        return
+                    except BaseException as e:
+                        # supervision: "restart" re-runs the worker from the
+                        # hub's CURRENT center (its committed progress
+                        # survives there), bounded by max_worker_restarts
+                        # and resuming at the epoch it died in; any other
+                        # policy records the error for the run-level
+                        # raise/continue handling below
+                        if (self.on_worker_failure != "restart"
+                                or restart_counts[idx] >= self.max_worker_restarts):
+                            errors.append(e)
+                            return
+                        restart_counts[idx] += 1
+                        # the replay re-records the aborted epoch's
+                        # windows: drop its partial losses so history
+                        # counts each trained window once
+                        del losses[progress[1]:]
+                        # surface the swallowed cause: an operator must be
+                        # able to tell two transient faults from the same
+                        # deterministic bug recurring every attempt
+                        import warnings
+
+                        warnings.warn(
+                            f"worker {idx} restarting "
+                            f"({restart_counts[idx]}/{self.max_worker_restarts}) "
+                            f"after {type(e).__name__}: {e}")
+                        if obs.enabled():
+                            obs.counter("worker.restarts").inc()
             finally:
                 if start_counted:
                     m_finished.inc()
@@ -455,6 +559,7 @@ class AsyncDistributedTrainer(Trainer):
                 errors.append(snap_err)  # recorded in worker_errors below
         if ps is not None:
             ps.stop()
+        self.worker_restarts = sum(restart_counts)
         self.worker_errors = list(errors)
         if errors and self.on_worker_failure == "raise":
             # surface the workers' root cause before touching the hub again
@@ -462,7 +567,11 @@ class AsyncDistributedTrainer(Trainer):
             raise errors[0]
         if ps is None:
             # worker-only mode: the external hub outlives us; read the center
-            with PSClient(ps_host, ps_port, templates=flat0) as final_client:
+            # (with the run's reconnect budget — a hub restart racing the
+            # end of the run must not lose an otherwise-complete result)
+            with PSClient(ps_host, ps_port, templates=flat0,
+                          max_reconnects=self.max_reconnects,
+                          reconnect_backoff=self.reconnect_backoff) as final_client:
                 final = final_client.pull()
         else:
             final = ps.get_weights()
@@ -489,8 +598,9 @@ class AsyncDOWNPOUR(AsyncDistributedTrainer):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DELTA, NativeParameterServer
 
-            return NativeParameterServer(weights, mode=MODE_DELTA)
-        return DeltaParameterServer(weights)
+            return NativeParameterServer(weights, mode=MODE_DELTA,
+                                         **self._hub_kwargs())
+        return DeltaParameterServer(weights, **self._hub_kwargs())
 
     def device_commit(self, pulled, local_after):
         delta = jax.tree.map(lambda l, p: l - p, local_after, pulled)
@@ -505,8 +615,12 @@ class AsyncADAG(AsyncDOWNPOUR):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_ADAG, NativeParameterServer
 
-            return NativeParameterServer(weights, mode=MODE_ADAG, num_workers=self.num_workers)
-        return ADAGParameterServer(weights, num_workers=self.num_workers)
+            return NativeParameterServer(weights, mode=MODE_ADAG,
+                                         num_workers=self.num_workers,
+                                         elastic=self.elastic,
+                                         **self._hub_kwargs())
+        return ADAGParameterServer(weights, num_workers=self.num_workers,
+                                   elastic=self.elastic, **self._hub_kwargs())
 
 
 class AsyncDynSGD(AsyncDOWNPOUR):
@@ -517,8 +631,9 @@ class AsyncDynSGD(AsyncDOWNPOUR):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DYNSGD, NativeParameterServer
 
-            return NativeParameterServer(weights, mode=MODE_DYNSGD)
-        return DynSGDParameterServer(weights)
+            return NativeParameterServer(weights, mode=MODE_DYNSGD,
+                                         **self._hub_kwargs())
+        return DynSGDParameterServer(weights, **self._hub_kwargs())
 
 
 class AsyncAEASGD(AsyncDistributedTrainer):
@@ -544,8 +659,9 @@ class AsyncAEASGD(AsyncDistributedTrainer):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DELTA, NativeParameterServer
 
-            return NativeParameterServer(weights, mode=MODE_DELTA)
-        return DeltaParameterServer(weights)
+            return NativeParameterServer(weights, mode=MODE_DELTA,
+                                         **self._hub_kwargs())
+        return DeltaParameterServer(weights, **self._hub_kwargs())
 
     def device_window_start(self, pulled, local):
         return local  # elastic workers keep their own trajectory

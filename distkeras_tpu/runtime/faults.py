@@ -1,0 +1,327 @@
+"""Deterministic chaos harness for the async PS stack.
+
+Fault-tolerance code is only as trustworthy as the faults it was tested
+against, and ad-hoc fault injection (kill a thread "somewhere in the
+middle", sleep and hope) makes failures unreproducible.  This module makes
+every fault a *scheduled, seedable event*:
+
+- :class:`Fault` / :class:`FaultPlan` — a declarative schedule of faults,
+  either written explicitly (``FaultPlan([Fault(conn=0, direction="s2c",
+  frame=3, kind="sever")])``) or generated from a seed
+  (:meth:`FaultPlan.random`), so a chaos test replays bit-identically.
+- :class:`ChaosProxy` — a frame-aware TCP proxy inserted between PSClient
+  workers and a hub.  It parses the length-prefixed frame stream in both
+  directions and, per the plan, **severs** the connection at frame *k*,
+  **delays** frame *k*, or **truncates** frame *k* mid-payload (the
+  half-written-frame shape a crashing peer actually produces).  Everything
+  not faulted is forwarded byte-exactly, so a proxied run with an empty
+  plan is indistinguishable from a direct one.
+- :class:`WorkerKillPlan` — seeded worker-kill schedule for the trainers'
+  ``fault_hook`` (raise at planned ``(worker, window)`` pairs, each fired
+  at most once — so a restarted worker replaying the window survives).
+
+Used by ``tests/test_faults.py`` (the fault-injection matrix) and
+``bench.py :: _bench_async_recovery`` (time-to-recover + loss-parity leg).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+SEVER = "sever"
+DELAY = "delay"
+TRUNCATE = "truncate"
+
+_KINDS = (SEVER, DELAY, TRUNCATE)
+_DIRECTIONS = ("c2s", "s2c")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: on proxied connection ``conn`` (accept
+    ordinal), in ``direction`` (``"c2s"`` client->server, ``"s2c"``
+    server->client), when frame ``frame`` (0-based per direction) crosses
+    the proxy, apply ``kind``:
+
+    - ``sever``: drop both directions of the connection before the frame
+      is forwarded (a crashed peer / yanked cable).
+    - ``delay``: hold the frame for ``delay_s`` seconds, then forward it
+      intact (a congested or GC-pausing peer).
+    - ``truncate``: forward the 8-byte header plus ``keep_bytes`` of the
+      payload, then sever (a peer that died MID-frame — the shape that
+      desynchronizes a stream and provokes half-read hangs)."""
+
+    conn: int
+    frame: int
+    direction: str = "s2c"
+    kind: str = SEVER
+    delay_s: float = 0.05
+    keep_bytes: int = 0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {self.kind!r}")
+        if self.direction not in _DIRECTIONS:
+            raise ValueError(f"direction must be one of {_DIRECTIONS}, "
+                             f"got {self.direction!r}")
+
+
+class FaultPlan:
+    """An immutable schedule of :class:`Fault` events, looked up by
+    ``(conn, direction, frame)``.  At most one fault per key (later
+    entries win).  ``seed`` only matters for :meth:`random`-built plans;
+    it is carried so a failing test can print the plan's provenance."""
+
+    def __init__(self, faults: Sequence[Fault] = (), seed: Optional[int] = None):
+        self.seed = seed
+        self.faults = tuple(faults)
+        self._by_key: Dict[Tuple[int, str, int], Fault] = {
+            (f.conn, f.direction, f.frame): f for f in self.faults}
+
+    @classmethod
+    def random(cls, seed: int, conns: int, frames: int,
+               n_faults: int = 1, kinds: Sequence[str] = (SEVER,),
+               direction: str = "s2c", delay_s: float = 0.05) -> "FaultPlan":
+        """Seeded plan: ``n_faults`` faults spread over ``conns``
+        connections x ``frames`` frames, deterministic in ``seed`` (the
+        reproducibility contract chaos tests rely on)."""
+        rng = np.random.default_rng(seed)
+        faults = []
+        for _ in range(n_faults):
+            faults.append(Fault(
+                conn=int(rng.integers(0, max(1, conns))),
+                # frame 0 is the very first exchange; faulting past it
+                # exercises an ESTABLISHED pipeline, which is the
+                # interesting case — so draw from [1, frames)
+                frame=int(rng.integers(1, max(2, frames))),
+                direction=direction,
+                kind=str(kinds[int(rng.integers(0, len(kinds)))]),
+                delay_s=delay_s,
+                keep_bytes=int(rng.integers(0, 9))))
+        return cls(faults, seed=seed)
+
+    def lookup(self, conn: int, direction: str, frame: int) -> Optional[Fault]:
+        return self._by_key.get((conn, direction, frame))
+
+    def __repr__(self) -> str:
+        return f"FaultPlan(seed={self.seed}, faults={list(self.faults)})"
+
+
+class ChaosProxy:
+    """Frame-aware TCP proxy: client connects to ``proxy.port``, the proxy
+    connects onward to ``(upstream_host, upstream_port)`` and pumps frames
+    both ways, consulting ``plan`` at every frame boundary.
+
+    Each accepted connection gets the next accept ordinal — a client that
+    reconnects after a sever arrives as a NEW ordinal, so a plan that
+    faults only ``conn=0`` exercises exactly one failure + recovery.
+
+    The proxy counts telemetry-free and allocation-light: frames are
+    relayed in bounded chunks (no whole-frame buffering), and an idle
+    proxy holds no locks on the data path."""
+
+    _CHUNK = 1 << 16
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 plan: Optional[FaultPlan] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.upstream = (upstream_host, int(upstream_port))
+        self.plan = plan or FaultPlan()
+        self.host = host
+        self.port = int(port)
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
+        self._pairs: List[Tuple[socket.socket, socket.socket]] = []
+        self._lock = threading.Lock()
+        self._running = False
+        self._conn_seq = 0
+        self.faults_fired: List[Fault] = []  # observability for tests/bench
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> "ChaosProxy":
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(64)
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            for a, b in self._pairs:
+                for s in (a, b):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- data path -------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while self._running:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                break
+            try:
+                server = socket.create_connection(self.upstream, timeout=30)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            conn_idx = self._conn_seq
+            self._conn_seq += 1
+            with self._lock:
+                if not self._running:
+                    for s in (client, server):
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    break
+                self._pairs.append((client, server))
+            for direction, src, dst in (("c2s", client, server),
+                                        ("s2c", server, client)):
+                t = threading.Thread(target=self._pump,
+                                     args=(conn_idx, direction, src, dst),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+            self._threads = [t for t in self._threads if t.is_alive()]
+
+    def _sever_pair(self, *socks: socket.socket) -> None:
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def _relay(self, src: socket.socket, dst: socket.socket, n: int) -> None:
+        """Move exactly ``n`` payload bytes src->dst in bounded chunks."""
+        left = n
+        buf = bytearray(min(self._CHUNK, max(1, n)))
+        while left:
+            want = min(len(buf), left)
+            got = src.recv_into(memoryview(buf)[:want], want)
+            if got == 0:
+                raise ConnectionError("peer closed mid-frame")
+            dst.sendall(memoryview(buf)[:got])
+            left -= got
+
+    def _pump(self, conn_idx: int, direction: str,
+              src: socket.socket, dst: socket.socket) -> None:
+        frame_idx = 0
+        try:
+            while True:
+                hdr = b""
+                while len(hdr) < 8:
+                    chunk = src.recv(8 - len(hdr))
+                    if not chunk:
+                        raise ConnectionError("EOF")
+                    hdr += chunk
+                (n,) = struct.unpack(">Q", hdr)
+                fault = self.plan.lookup(conn_idx, direction, frame_idx)
+                if fault is not None:
+                    self.faults_fired.append(fault)
+                    if fault.kind == SEVER:
+                        self._sever_pair(src, dst)
+                        return
+                    if fault.kind == TRUNCATE:
+                        # forward the header claiming n bytes, deliver only
+                        # keep_bytes, then die: the receiver is left
+                        # blocked mid-frame exactly like a crashed peer
+                        keep = min(int(fault.keep_bytes), n)
+                        dst.sendall(hdr)
+                        if keep:
+                            self._relay(src, dst, keep)
+                        self._sever_pair(src, dst)
+                        return
+                    if fault.kind == DELAY:
+                        time.sleep(fault.delay_s)
+                dst.sendall(hdr)
+                self._relay(src, dst, n)
+                frame_idx += 1
+        except (ConnectionError, OSError):
+            # one side died (or a planned sever on the twin pump): make
+            # sure the other side observes it too, then exit quietly
+            self._sever_pair(src, dst)
+
+
+class WorkerKillPlan:
+    """Deterministic in-process worker kills for the trainers'
+    ``fault_hook``: raises :class:`InjectedWorkerFault` the first time a
+    planned ``(worker, window)`` boundary is reached — and never again for
+    that pair, so a supervisor-restarted worker replaying the same window
+    proceeds.  Thread-safe (each worker runs its own thread)."""
+
+    def __init__(self, kills: Sequence[Tuple[int, int]] = (),
+                 seed: Optional[int] = None):
+        self.seed = seed
+        self.kills: Set[Tuple[int, int]] = {(int(w), int(k)) for w, k in kills}
+        self.fired: List[Tuple[int, int]] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def random(cls, seed: int, num_workers: int, windows: int,
+               n_kills: int = 1) -> "WorkerKillPlan":
+        rng = np.random.default_rng(seed)
+        kills = {(int(rng.integers(0, max(1, num_workers))),
+                  int(rng.integers(1, max(2, windows))))
+                 for _ in range(n_kills)}
+        return cls(kills, seed=seed)
+
+    def hook(self, worker: int, window: int) -> None:
+        """Pass as ``fault_hook=plan.hook``."""
+        key = (worker, window)
+        with self._lock:
+            if key in self.kills and key not in self.fired:
+                self.fired.append(key)
+                raise InjectedWorkerFault(
+                    f"injected fault: worker {worker} dies at window {window} "
+                    f"(plan seed={self.seed})")
+
+
+class InjectedWorkerFault(RuntimeError):
+    """The exception :class:`WorkerKillPlan` kills workers with — a
+    distinct type so tests can assert the recorded error is the injected
+    one and not an incidental bug."""
+
+
+__all__ = [
+    "Fault", "FaultPlan", "ChaosProxy", "WorkerKillPlan",
+    "InjectedWorkerFault", "SEVER", "DELAY", "TRUNCATE",
+]

@@ -94,18 +94,34 @@ def process_shard(dataset: Any) -> Any:
 
 def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1,
                            host: str = "0.0.0.0", port: int = 0,
-                           native: bool = False) -> Any:
+                           native: bool = False,
+                           elastic: bool = False,
+                           idle_timeout: Optional[float] = 300.0,
+                           snapshot_dir: Optional[str] = None,
+                           snapshot_interval: float = 30.0,
+                           restore: bool = False) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
 
     ``mode``: ``delta`` (DOWNPOUR/elastic) | ``adag`` | ``dynsgd``.
     ``native=True`` uses the C++ hub (commits apply outside the GIL).
+
+    Fault tolerance (both hubs): ``snapshot_dir`` makes the hub snapshot
+    its center + commit clock every ``snapshot_interval`` seconds (atomic
+    tmp+rename via the Checkpointer); ``restore=True`` resumes a restarted
+    hub from the newest readable snapshot — with a clock fence that clamps
+    pre-restart pull clocks — BEFORE serving, so workers reconnecting via
+    backoff land on the recovered center.  ``idle_timeout`` evicts
+    half-open connections; ``elastic`` (adag) normalizes commits by the
+    live worker count instead of ``num_workers``.
     """
     from distkeras_tpu.utils import flatten_weights
 
     flat, _ = flatten_weights(model.params)
     weights = [np.asarray(w, dtype=np.float32) for w in flat]
+    common = dict(idle_timeout=idle_timeout, snapshot_dir=snapshot_dir,
+                  snapshot_interval=snapshot_interval, restore=restore)
     if native:
         from distkeras_tpu.runtime.native import (
             MODE_ADAG, MODE_DELTA, MODE_DYNSGD, NativeParameterServer)
@@ -113,15 +129,15 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
         native_mode = {"delta": MODE_DELTA, "adag": MODE_ADAG, "dynsgd": MODE_DYNSGD}[mode]
         # the C++ hub binds all interfaces; host selection is Python-hub only
         ps = NativeParameterServer(weights, mode=native_mode, num_workers=num_workers,
-                                   port=port)
+                                   port=port, elastic=elastic, **common)
     else:
         from distkeras_tpu.runtime.parameter_server import (
             ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer)
 
         cls = {"delta": DeltaParameterServer, "adag": ADAGParameterServer,
                "dynsgd": DynSGDParameterServer}[mode]
-        kwargs = {"num_workers": num_workers} if mode == "adag" else {}
-        ps = cls(weights, host=host, port=port, **kwargs)
+        kwargs = {"num_workers": num_workers, "elastic": elastic} if mode == "adag" else {}
+        ps = cls(weights, host=host, port=port, **kwargs, **common)
     ps.start()
     return ps
 
@@ -145,14 +161,36 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--native", action="store_true", help="use the C++ hub")
     parser.add_argument("--save-final", default=None,
                         help="on shutdown, write the final center model here")
+    parser.add_argument("--snapshot-dir", default=None,
+                        help="periodically snapshot center+clock here (atomic; "
+                             "survives SIGKILL)")
+    parser.add_argument("--snapshot-interval", type=float, default=30.0,
+                        help="seconds between hub snapshots")
+    parser.add_argument("--restore", action="store_true",
+                        help="resume from the newest readable snapshot in "
+                             "--snapshot-dir before serving (clock-fenced)")
+    parser.add_argument("--idle-timeout", type=float, default=300.0,
+                        help="evict connections silent for this many seconds "
+                             "(half-open liveness); <= 0 disables")
+    parser.add_argument("--elastic", action="store_true",
+                        help="adag: normalize commits by the LIVE worker "
+                             "count instead of --num-workers")
     args = parser.parse_args(argv)
+    if args.restore and not args.snapshot_dir:
+        parser.error("--restore requires --snapshot-dir")
 
     from distkeras_tpu.models.base import Model
 
     with open(args.model, "rb") as f:
         model = Model.deserialize(f.read())
     ps = start_parameter_server(model, mode=args.mode, num_workers=args.num_workers,
-                                host=args.host, port=args.port, native=args.native)
+                                host=args.host, port=args.port, native=args.native,
+                                elastic=args.elastic,
+                                idle_timeout=(args.idle_timeout
+                                              if args.idle_timeout > 0 else None),
+                                snapshot_dir=args.snapshot_dir,
+                                snapshot_interval=args.snapshot_interval,
+                                restore=args.restore)
     print(f"ps listening on {args.host}:{ps.port}", flush=True)
     try:
         while True:

@@ -98,7 +98,10 @@ class LazyNativeLib:
 def _bind_ps(lib: ctypes.CDLL) -> None:
     lib.dk_ps_create.restype = ctypes.c_void_p
     lib.dk_ps_create.argtypes = [ctypes.c_int, ctypes.c_int,
-                                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+                                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_int, ctypes.c_int]
+    lib.dk_ps_restore.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_int64, ctypes.c_int64]
     lib.dk_ps_start.restype = ctypes.c_int
     lib.dk_ps_start.argtypes = [ctypes.c_void_p]
     lib.dk_ps_stop.argtypes = [ctypes.c_void_p]
@@ -132,18 +135,33 @@ def build_error() -> Optional[str]:
 
 class NativeParameterServer:
     """C++ PS hub with the Python hub's interface.  ``mode`` selects the
-    commit-scaling rule (MODE_DELTA / MODE_ADAG / MODE_DYNSGD)."""
+    commit-scaling rule (MODE_DELTA / MODE_ADAG / MODE_DYNSGD).
+
+    Fault-tolerance surface matches the Python hub: ``idle_timeout``
+    evicts half-open connections via ``SO_RCVTIMEO``; ``elastic=True``
+    normalizes ADAG commits by the live committer count; ``snapshot_dir``
+    attaches a :class:`~.parameter_server.HubSnapshotter` (periodic atomic
+    center+clock snapshots) and ``restore=True`` reloads the newest one —
+    with the clock fence armed in C++ — before serving."""
 
     def __init__(self, weights: Sequence[np.ndarray], mode: int = MODE_DELTA,
-                 num_workers: int = 1, port: int = 0):
+                 num_workers: int = 1, port: int = 0,
+                 elastic: bool = False,
+                 idle_timeout: Optional[float] = 300.0,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_interval: float = 30.0,
+                 snapshot_keep: int = 3,
+                 restore: bool = False):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native PS unavailable: {build_error()}")
         self._lib = lib
         self._templates = [np.array(w, dtype=np.float32) for w in weights]
         sizes = (ctypes.c_int64 * len(self._templates))(*[t.size for t in self._templates])
+        idle_ms = 0 if idle_timeout is None else max(1, int(idle_timeout * 1000))
         self._handle = lib.dk_ps_create(int(port), len(self._templates), sizes,
-                                        int(mode), int(num_workers))
+                                        int(mode), int(num_workers),
+                                        1 if elastic else 0, idle_ms)
         if not self._handle:
             raise RuntimeError("dk_ps_create failed")
         flat = np.concatenate([t.reshape(-1) for t in self._templates]) if self._templates \
@@ -152,18 +170,75 @@ class NativeParameterServer:
         lib.dk_ps_set_weights(self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         self.port = -1
         self._started = False
+        self._restore = bool(restore)
+        self.snapshotter = None
+        if restore and snapshot_dir is None:
+            raise ValueError("restore=True requires snapshot_dir")
+        if snapshot_dir is not None:
+            from distkeras_tpu.runtime.parameter_server import HubSnapshotter
+
+            self.snapshotter = HubSnapshotter(self, snapshot_dir,
+                                              interval=snapshot_interval,
+                                              keep=snapshot_keep)
 
     def start(self) -> None:
+        if self._restore and self.snapshotter is not None:
+            # same contract as the Python hub: unreadable-but-present
+            # snapshots are fatal (don't silently discard a job's
+            # progress); a genuinely empty dir is a first boot
+            if not self.snapshotter.restore_latest():
+                if self.snapshotter.checkpointer.all_steps():
+                    raise RuntimeError(
+                        f"restore requested: snapshots exist in "
+                        f"{self.snapshotter.checkpointer.directory} but none "
+                        f"is readable (see warnings)")
+                import warnings
+
+                warnings.warn("restore requested but no snapshot exists "
+                              "yet; serving initial weights")
         port = self._lib.dk_ps_start(self._handle)
         if port < 0:
             raise RuntimeError("native PS failed to bind")
         self.port = port
         self._started = True
+        if self.snapshotter is not None:
+            self.snapshotter.start()
 
     def stop(self) -> None:
+        self._shutdown(final_snapshot=True)
+
+    def kill(self) -> None:
+        """Crash-like teardown (no final snapshot) — the C++ twin of
+        ``SocketParameterServer.kill``."""
+        self._shutdown(final_snapshot=False)
+
+    def _shutdown(self, final_snapshot: bool) -> None:
         if self._started:
+            if self.snapshotter is not None:
+                self.snapshotter.stop(final_snapshot=final_snapshot)
             self._lib.dk_ps_stop(self._handle)
             self._started = False
+
+    # -- durability (HubSnapshotter surface) -----------------------------------
+    def snapshot_state(self):
+        """(center tensors, JSON-typed state dict) — one atomic view via the
+        C++ pull path (center + clock under the hub mutex)."""
+        center, clock = self.pull_direct()
+        return ([c.copy() for c in center],
+                {"clock": int(clock), "num_updates": int(self.num_updates)})
+
+    def restore_state(self, center: Sequence[np.ndarray], state) -> None:
+        if len(center) != len(self._templates):
+            raise ValueError(f"snapshot has {len(center)} tensors, center has "
+                             f"{len(self._templates)}")
+        parts = [np.ascontiguousarray(c, np.float32).reshape(-1) for c in center]
+        flat = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+        if flat.size != self._total:
+            raise ValueError(f"snapshot has {flat.size} values, center has "
+                             f"{self._total}")
+        self._lib.dk_ps_restore(
+            self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(state.get("clock", 0)), int(state.get("num_updates", 0)))
 
     def get_weights(self) -> List[np.ndarray]:
         out = np.zeros(self._total, np.float32)

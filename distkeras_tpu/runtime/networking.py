@@ -70,6 +70,17 @@ ACTION_QCOMMIT = b"Q"
 ACTION_BYE = b"B"
 ACTION_WEIGHTS = b"W"
 ACTION_ACK = b"A"
+ACTION_PING = b"H"  # client heartbeat-on-idle; hub replies with an ack
+
+
+class ProtocolError(ValueError):
+    """A frame violated the wire contract: garbage/oversized length prefix,
+    truncated payload, tensor layout that does not match the schema.  After
+    one of these the stream is desynchronized — callers must drop (and may
+    re-establish) the connection.  Subclasses ``ValueError`` so every
+    pre-existing ``except ValueError`` stays correct; the distinct type
+    lets resilience layers (PSClient reconnect, hub eviction) treat
+    malformed bytes as a connection fault rather than a caller bug."""
 
 
 def determine_host_address() -> str:
@@ -160,7 +171,7 @@ def recv_frame(sock: socket.socket, limit: int = MAX_FRAME) -> bytes:
     the peer has authenticated)."""
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
     if n > limit:
-        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
+        raise ProtocolError(f"frame of {n} bytes exceeds limit={limit}")
     payload = _recv_exact(sock, n)
     # count only after the body fully arrived: a peer dying mid-frame must
     # not inflate the byte accounting by data that never landed
@@ -180,7 +191,7 @@ def recv_frame_into(sock: socket.socket, buf: bytearray,
     through one of these per connection."""
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
     if n > limit:
-        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
+        raise ProtocolError(f"frame of {n} bytes exceeds limit={limit}")
     if len(buf) < n:
         try:
             buf.extend(bytes(n - len(buf)))
@@ -239,7 +250,7 @@ def decode_tensors(payload: bytes) -> Tuple[bytes, List[bytes]]:
         blobs.append(payload[off:off + nbytes])
         off += nbytes
     if off != len(payload):
-        raise ValueError(f"tensor frame has {len(payload) - off} trailing bytes")
+        raise ProtocolError(f"tensor frame has {len(payload) - off} trailing bytes")
     return action, blobs
 
 
@@ -257,11 +268,11 @@ def decode_tensor_views(payload) -> Tuple[bytes, List[memoryview]]:
         (nbytes,) = struct.unpack(">Q", mv[off:off + 8])
         off += 8
         if off + nbytes > len(mv):
-            raise ValueError("tensor frame truncated mid-blob")
+            raise ProtocolError("tensor frame truncated mid-blob")
         blobs.append(mv[off:off + nbytes])
         off += nbytes
     if off != len(mv):
-        raise ValueError(f"tensor frame has {len(mv) - off} trailing bytes")
+        raise ProtocolError(f"tensor frame has {len(mv) - off} trailing bytes")
     return action, blobs
 
 
@@ -276,21 +287,21 @@ def _scatter_recv_into(sock: socket.socket, out: Sequence[np.ndarray],
     _recv_exact_into(sock, scratch[:8])
     (n,) = struct.unpack(">Q", scratch[:8])
     if n > limit:
-        raise ValueError(f"frame of {n} bytes exceeds limit={limit}")
+        raise ProtocolError(f"frame of {n} bytes exceeds limit={limit}")
     expected = 5 + sum(8 + a.nbytes for a in out)
     if n != expected:
-        raise ValueError(f"tensor frame of {n} payload bytes does not match "
+        raise ProtocolError(f"tensor frame of {n} payload bytes does not match "
                          f"the expected layout ({expected} bytes)")
     _recv_exact_into(sock, scratch[:5])
     action = bytes(scratch[:1])
     (count,) = struct.unpack(">I", scratch[1:5])
     if count != len(out):
-        raise ValueError(f"frame has {count} tensors, expected {len(out)}")
+        raise ProtocolError(f"frame has {count} tensors, expected {len(out)}")
     for dst in out:
         _recv_exact_into(sock, scratch[:8])
         (nbytes,) = struct.unpack(">Q", scratch[:8])
         if nbytes != dst.nbytes or not dst.flags.c_contiguous:
-            raise ValueError(f"tensor of {nbytes} bytes does not match its "
+            raise ProtocolError(f"tensor of {nbytes} bytes does not match its "
                              f"output slot ({dst.nbytes} bytes, contiguous)")
         _recv_exact_into(sock, memoryview(dst).cast("B"))
     if obs.enabled():
@@ -311,11 +322,11 @@ def recv_action(sock: socket.socket) -> bytes:
     the pipelined client) and return its action byte."""
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
     if n != 5:
-        raise ValueError(f"expected a tensor-less frame, got {n}-byte payload")
+        raise ProtocolError(f"expected a tensor-less frame, got {n}-byte payload")
     payload = _recv_exact(sock, 5)
     (count,) = struct.unpack(">I", payload[1:5])
     if count != 0:
-        raise ValueError(f"expected zero tensors, frame declares {count}")
+        raise ProtocolError(f"expected zero tensors, frame declares {count}")
     if obs.enabled():
         obs.counter("net_rx_frames_total").inc()
         obs.counter("net_rx_bytes_total").inc(8 + n)
@@ -438,7 +449,7 @@ def quantize_q_blob(delta: np.ndarray) -> Tuple[bytes, np.ndarray]:
 def dequantize_q_blob(blob: bytes, size: int) -> np.ndarray:
     """Inverse of :func:`quantize_q_blob`: flat float32 array of ``size``."""
     if len(blob) != 4 + size:
-        raise ValueError(f"Q blob of {len(blob)} bytes != 4 + {size}")
+        raise ProtocolError(f"Q blob of {len(blob)} bytes != 4 + {size}")
     (scale,) = struct.unpack(">f", blob[:4])
     return np.frombuffer(blob, dtype=np.int8, offset=4).astype(np.float32) * np.float32(scale)
 

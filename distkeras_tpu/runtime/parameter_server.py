@@ -32,10 +32,13 @@ Server classes mirror the reference's:
 
 from __future__ import annotations
 
+import contextlib
+import random
 import socket
 import threading
+import warnings
 from collections import deque
-from typing import Any, Deque, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import time
 
@@ -43,6 +46,92 @@ import numpy as np
 
 from distkeras_tpu import observability as obs
 from distkeras_tpu.runtime import networking as net
+
+
+class HubSnapshotter:
+    """Periodic durability for a PS hub: every ``interval`` seconds (and
+    once at stop) the hub's full recoverable state — center weights, commit
+    clock, update count, algorithm extras — is written through
+    :class:`distkeras_tpu.checkpoint.Checkpointer` (atomic tmp+rename, so a
+    hub SIGKILLed mid-save leaves the previous snapshot intact).  A
+    restarted hub calls :meth:`restore_latest` BEFORE serving: the center
+    resumes from the last snapshot and the commit clock re-arms behind a
+    fence (``restore_state`` on the hub) that neutralizes pre-restart stale
+    clocks.  Works against any hub exposing ``snapshot_state()`` /
+    ``restore_state()`` — the Python hubs here and the C++ hub wrapper
+    (:mod:`distkeras_tpu.runtime.native`) both do.
+
+    Telemetry: ``ps.snapshot_ms`` save-latency histogram,
+    ``ps_snapshots_total`` counter."""
+
+    def __init__(self, hub: Any, directory: str, interval: float = 30.0,
+                 keep: int = 3):
+        from distkeras_tpu.checkpoint import Checkpointer
+
+        self.hub = hub
+        self.interval = float(interval)
+        self.checkpointer = Checkpointer(directory, keep=keep)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes the periodic loop against the final stop() snapshot
+        self._save_lock = threading.Lock()
+        self._next_step = (self.checkpointer.latest_step() or 0) + 1
+
+    def restore_latest(self) -> bool:
+        """Load the newest readable snapshot into the hub; ``True`` if one
+        was restored.  Corrupt/partial snapshots (killed mid-write by
+        something stronger than the atomic rename — disk truncation, a
+        torn copy) are skipped with a warning, falling back to the next
+        older one."""
+        templates = self.hub.get_weights()
+        for step in reversed(self.checkpointer.all_steps()):
+            try:
+                trees = self.checkpointer.restore({"center": templates}, step=step)
+                meta = self.checkpointer.metadata(step=step).get("metadata", {})
+            except Exception as e:
+                warnings.warn(f"skipping unreadable PS snapshot step {step}: "
+                              f"{type(e).__name__}: {e}")
+                continue
+            self.hub.restore_state(trees["center"], meta)
+            self._next_step = max(self._next_step, step + 1)
+            return True
+        return False
+
+    def save_now(self) -> None:
+        with self._save_lock:
+            t0 = time.perf_counter()
+            center, state = self.hub.snapshot_state()
+            self.checkpointer.save(
+                self._next_step, {"center": center},
+                metadata={"kind": "ps-hub-snapshot", **state})
+            self._next_step += 1
+            if obs.enabled():
+                obs.histogram("ps.snapshot_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+                obs.counter("ps_snapshots_total").inc()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.save_now()
+            except Exception as e:  # a full disk must not kill the hub
+                warnings.warn(f"PS snapshot failed: {type(e).__name__}: {e}")
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, final_snapshot: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if final_snapshot:
+            try:
+                self.save_now()
+            except Exception as e:
+                warnings.warn(f"final PS snapshot failed: {type(e).__name__}: {e}")
 
 
 class SocketParameterServer:
@@ -64,12 +153,23 @@ class SocketParameterServer:
     socket exchange) so a mid-run ``obs.reset()`` cannot orphan them, and
     nothing is registered at all while telemetry is off."""
 
-    def __init__(self, weights: Sequence[np.ndarray], host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, weights: Sequence[np.ndarray], host: str = "0.0.0.0", port: int = 0,
+                 idle_timeout: Optional[float] = 300.0,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_interval: float = 30.0,
+                 snapshot_keep: int = 3,
+                 restore: bool = False):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
         self.num_updates = 0
         self._clock = 0  # total commits applied (DynSGD's global clock)
+        # restore-time fence: connections and inproc clients born before a
+        # hub restart carry pull clocks from the PREVIOUS incarnation;
+        # clamping them here re-bases their staleness at the restart point
+        # instead of letting a pre-restart clock fake a huge (DynSGD) or
+        # negative staleness
+        self._clock_fence = 0
         self._lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -81,10 +181,55 @@ class SocketParameterServer:
         # full flat-frame size of a pull reply / f32 commit (header, action,
         # count, per-tensor prefixes, payload) — the socket-buffer hint
         self._frame_bytes = 13 + sum(8 + w.nbytes for w in self.center)
+        # largest VALID payload a peer may declare.  Per tensor that is
+        # the larger of the f32 blob (4*size) and the int8 Q blob
+        # (4 + size — bigger for SCALAR leaves).  The handler receives
+        # against this bound, so a garbage length prefix is a typed
+        # ProtocolError instead of a 16 GiB bytearray
+        self._max_payload = 5 + sum(8 + max(w.nbytes, 4 + w.size)
+                                    for w in self.center)
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
+        # half-open liveness: a peer that dies without FIN used to park its
+        # handler in recv() forever.  With idle_timeout set, a connection
+        # silent for that long (no pull/commit/heartbeat) is evicted
+        self.idle_timeout = None if idle_timeout is None else float(idle_timeout)
+        # live-worker membership (elastic denominators): a connection joins
+        # on its first commit — pull-only peers (snapshot readers, final
+        # center fetches) never count — is touched by every action, and
+        # leaves on disconnect or idle eviction
+        self._members: Dict[int, float] = {}
+        self._member_lock = threading.Lock()
+        self._member_seq = 0
+        self.snapshotter: Optional[HubSnapshotter] = None
+        self._restore = bool(restore)
+        if restore and snapshot_dir is None:
+            # silently serving FRESH weights after an operator asked for a
+            # restore would discard a job's progress without a sound
+            raise ValueError("restore=True requires snapshot_dir")
+        if snapshot_dir is not None:
+            self.snapshotter = HubSnapshotter(self, snapshot_dir,
+                                              interval=snapshot_interval,
+                                              keep=snapshot_keep)
 
     # -- lifecycle (reference: ParameterServer.start/stop) ---------------------
     def start(self) -> None:
+        if self._restore and self.snapshotter is not None:
+            # load BEFORE binding: the first pull any worker lands must
+            # already observe the restored center and fenced clock
+            if not self.snapshotter.restore_latest():
+                if self.snapshotter.checkpointer.all_steps():
+                    # progress exists on disk but none of it is readable —
+                    # binding anyway would hand workers a fresh center and
+                    # silently discard the job; that needs a human
+                    raise RuntimeError(
+                        f"restore requested: snapshots exist in "
+                        f"{self.snapshotter.checkpointer.directory} but none "
+                        f"is readable (see warnings)")
+                # no snapshot yet (first boot under a restart-with-restore
+                # supervisor loop): serving initial weights is correct,
+                # but say so
+                warnings.warn("restore requested but no snapshot exists "
+                              "yet; serving initial weights")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -93,9 +238,27 @@ class SocketParameterServer:
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+        if self.snapshotter is not None:
+            self.snapshotter.start()
 
     def stop(self) -> None:
+        self._shutdown(final_snapshot=True)
+
+    def kill(self) -> None:
+        """Crash-like teardown for chaos tests and recovery drills: sever
+        everything WITHOUT a final snapshot — recovery must come from the
+        last periodic snapshot, exactly as after a SIGKILL.  (From the
+        workers' side this is indistinguishable from a killed process:
+        connections reset mid-exchange, port goes dark.)"""
+        self._shutdown(final_snapshot=False)
+
+    def _shutdown(self, final_snapshot: bool) -> None:
         self._running = False
+        if self.snapshotter is not None:
+            # on stop(): final snapshot while the center is still intact
+            # (commits may still be landing — snapshot_state copies under
+            # the lock); on kill(): just halt the periodic thread
+            self.snapshotter.stop(final_snapshot=final_snapshot)
         if self._listener is not None:
             try:
                 # shutdown BEFORE close: close() alone does not wake a
@@ -129,6 +292,70 @@ class SocketParameterServer:
     def get_weights(self) -> List[np.ndarray]:
         with self._lock:
             return [w.copy() for w in self.center]
+
+    # -- durability (hub snapshots + clock fence) ------------------------------
+    def snapshot_state(self) -> Tuple[List[np.ndarray], Dict[str, Any]]:
+        """One atomic view of everything a restarted hub needs: (center
+        copy, state dict).  The state rides the snapshot's JSON metadata,
+        so it must stay JSON-typed."""
+        with self._lock:
+            center = [w.copy() for w in self.center]
+            state = {"clock": int(self._clock),
+                     "num_updates": int(self.num_updates)}
+            state.update(self._algo_state())
+        return center, state
+
+    def _algo_state(self) -> Dict[str, Any]:
+        """Subclass hook: algorithm state to persist alongside the center
+        (called under the center lock)."""
+        return {}
+
+    def restore_state(self, center: Sequence[np.ndarray],
+                      state: Dict[str, Any]) -> None:
+        """Load a snapshot: center in place (buffer identity preserved — the
+        frame-size accounting and any live codecs stay valid), clock
+        resumed, and the clock FENCE armed at the restored clock so any
+        pre-restart pull clock presented to :meth:`commit_direct` is
+        clamped to the restart point."""
+        if len(center) != len(self.center):
+            raise ValueError(f"snapshot has {len(center)} tensors, center has "
+                             f"{len(self.center)}")
+        with self._lock:
+            for c, w in zip(self.center, center):
+                c[...] = np.asarray(w, np.float32).reshape(c.shape)
+            self._clock = int(state.get("clock", 0))
+            self._clock_fence = self._clock
+            self.num_updates = int(state.get("num_updates", 0))
+
+    # -- elastic membership ----------------------------------------------------
+    def _member_join(self, token: int) -> None:
+        with self._member_lock:
+            self._members[token] = time.monotonic()
+        if obs.enabled():
+            obs.gauge("ps_live_workers").set(self.live_workers())
+
+    def _member_touch(self, token: int) -> None:
+        with self._member_lock:
+            if token in self._members:
+                self._members[token] = time.monotonic()
+
+    def _member_leave(self, token: int) -> None:
+        with self._member_lock:
+            self._members.pop(token, None)
+        if obs.enabled():
+            obs.gauge("ps_live_workers").set(self.live_workers())
+
+    def live_workers(self) -> int:
+        """Workers currently believed alive: joined (committed at least
+        once), not departed, and — when ``idle_timeout`` is set — heard
+        from within it (heartbeat-lapse detection for peers whose
+        connection is technically open but silent)."""
+        now = time.monotonic()
+        with self._member_lock:
+            if self.idle_timeout is None:
+                return len(self._members)
+            return sum(1 for last in self._members.values()
+                       if now - last <= self.idle_timeout)
 
     # -- serving loop (reference: SocketParameterServer.run) -------------------
     def _accept_loop(self) -> None:
@@ -193,7 +420,15 @@ class SocketParameterServer:
                 for blob, c in zip(blobs, self.center)]
 
     def _handle_connection(self, conn: socket.socket, conn_idx: int = 0) -> None:
-        last_pull_clock = 0
+        # connections born after a restore start AT the fence: their first
+        # commit-before-pull is stale relative to the restart point, not to
+        # clock zero of a previous incarnation
+        with self._lock:
+            last_pull_clock = self._clock_fence
+        with self._member_lock:
+            self._member_seq += 1
+            member_token = self._member_seq
+        joined = False
         # per-connection reusable storage: the receive buffer grows once to
         # the largest frame this worker sends (a commit), the reply codec
         # holds one prepacked weights frame, the ack is a 13-byte constant
@@ -201,12 +436,30 @@ class SocketParameterServer:
         rx = bytearray(self._frame_bytes)
         reply = net.FlatFrameCodec(self.center)
         ack = net.empty_tensor_frame(net.ACTION_ACK)
+        if self.idle_timeout is not None:
+            # per-recv liveness bound: a peer that dies without FIN (host
+            # crash, cable pull) no longer parks this handler forever
+            conn.settimeout(self.idle_timeout)
         try:
             while True:
                 # raw receive: pull/bye carry zero tensors, commit carries
-                # len(center) — decode against the center only on commit
-                payload = net.recv_frame_into(conn, rx)
+                # len(center) — decode against the center only on commit.
+                # The bound is the largest VALID frame (an f32 commit), so
+                # a garbage length prefix raises ProtocolError instead of
+                # allocating whatever the 8 bytes happened to say
+                try:
+                    payload = net.recv_frame_into(conn, rx,
+                                                  limit=self._max_payload)
+                except socket.timeout:
+                    # silent past the liveness window (no heartbeat, no
+                    # traffic): evict — half-open peers must not hold a
+                    # handler thread and a membership slot forever
+                    if obs.enabled():
+                        obs.counter("ps_idle_evictions_total").inc()
+                    break
                 action, blobs = net.decode_tensor_views(payload)
+                if joined:
+                    self._member_touch(member_token)
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
@@ -226,6 +479,12 @@ class SocketParameterServer:
                     delta = (self._decode_delta(blobs)
                              if action == net.ACTION_COMMIT
                              else self._decode_qdelta(blobs))
+                    if not joined:
+                        # first commit = this peer is a WORKER (pull-only
+                        # readers never join): membership drives the
+                        # elastic denominators
+                        joined = True
+                        self._member_join(member_token)
                     with self._lock:
                         staleness = self._clock - last_pull_clock
                         self.apply_commit(delta, staleness)
@@ -246,13 +505,20 @@ class SocketParameterServer:
                         obs.gauge("ps_staleness",
                                   conn=str(conn_idx)).set(staleness)
                         obs.histogram("ps_commit_staleness").observe(staleness)
+                elif action == net.ACTION_PING:
+                    # heartbeat-on-idle: proves liveness (resetting the
+                    # idle clock above) and keeps a slow-but-alive worker's
+                    # membership from lapsing; acked so the client can
+                    # bound its own round trips
+                    net.send_raw_frame(conn, ack)
                 elif action == net.ACTION_BYE:
                     break
                 else:
-                    raise ValueError(f"unknown action {action!r}")
+                    raise net.ProtocolError(f"unknown action {action!r}")
         except (ConnectionError, ValueError, OSError):
             pass  # worker vanished mid-exchange; reference behavior: drop it
         finally:
+            self._member_leave(member_token)
             try:
                 conn.close()
             except OSError:
@@ -302,6 +568,13 @@ class SocketParameterServer:
         arrays = [np.asarray(d, np.float32).reshape(c.shape)
                   for d, c in zip(delta, self.center)]
         with self._lock:
+            if last_pull_clock < self._clock_fence:
+                # pre-restart pull clock: fence it at the restore point —
+                # the commit applies with restart-relative staleness
+                # instead of a clock from a dead incarnation
+                last_pull_clock = self._clock_fence
+                if telemetry:
+                    obs.counter("ps_fenced_commits_total").inc()
             staleness = self._clock - last_pull_clock
             self.apply_commit(arrays, staleness)
             self.num_updates += 1
@@ -329,14 +602,42 @@ class DeltaParameterServer(SocketParameterServer):
 
 class ADAGParameterServer(SocketParameterServer):
     """ADAG normalization: ``center += delta / num_workers`` (reference
-    ``ADAGParameterServer.handle_commit``, SURVEY §2.6)."""
+    ``ADAGParameterServer.handle_commit``, SURVEY §2.6).
 
-    def __init__(self, weights: Sequence[np.ndarray], num_workers: int, **kwargs):
+    ``elastic=True`` replaces the static configured denominator with the
+    LIVE worker count from hub membership (join on first commit, leave on
+    disconnect/idle-lapse, capped at num_workers): when a worker dies
+    permanently mid-run, the survivors' deltas stop being diluted by a
+    ghost — degraded-but-correct averaging under churn, the elastic
+    coordination the EASGD lineage (arXiv:1412.6651) is built on.  The
+    cap keeps transient over-registration (a worker reconnecting before
+    its old handler noticed the death) from scaling commits UP past the
+    configured cohort; zero membership (commits arriving via
+    ``commit_direct`` — the inproc transport, which has no connections to
+    track) falls back to the static ``num_workers`` denominator."""
+
+    def __init__(self, weights: Sequence[np.ndarray], num_workers: int,
+                 elastic: bool = False, **kwargs):
         super().__init__(weights, **kwargs)
         self.num_workers = int(num_workers)
+        self.elastic = bool(elastic)
+
+    def _algo_state(self) -> Dict[str, Any]:
+        return {"num_workers": self.num_workers, "elastic": self.elastic}
 
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:
-        inv = 1.0 / self.num_workers
+        n = self.num_workers
+        if self.elastic:
+            live = self.live_workers()
+            # membership is a SOCKET-connection concept (join on first
+            # commit, leave on disconnect): a socket committer is always
+            # its own live member, so live >= 1 here for wire commits.
+            # live == 0 means this commit arrived via commit_direct
+            # (inproc workers bypass connections) — fall back to the
+            # static denominator rather than scaling by 1/1, which would
+            # over-apply every inproc delta num_workers-fold
+            n = min(live, self.num_workers) if live >= 1 else self.num_workers
+        inv = 1.0 / n
         for c, d in zip(self.center, delta):
             c += d * inv
 
@@ -405,17 +706,45 @@ class PSClient:
     Pulls always stay full precision: weight error hits the model
     directly, while delta rounding error is recycled.
 
+    Resilience (``timeout`` is the per-recv/send socket timeout — a hub
+    that stops responding surfaces as ``socket.timeout`` instead of a
+    hang): with ``max_reconnects > 0``, any connection fault (reset, EOF,
+    recv timeout, desynchronized stream) triggers reconnection with
+    exponential backoff + jitter — in-flight pipelined state is DISCARDED
+    (unacked commits are lost; async SGD tolerates dropped updates),
+    in-flight pulls are re-issued against the new connection so the next
+    ``wait_weights`` observes the (possibly restarted) hub's fresh center,
+    and the interrupted operation is retried.  ``max_reconnects`` is a
+    lifetime budget (a flapping hub cannot storm forever);
+    ``reconnect_backoff`` seeds the exponential delay, capped at
+    ``reconnect_backoff_max``, each attempt jittered into
+    ``[0.5, 1.0] x`` the nominal delay so a fleet of workers does not
+    thundering-herd a restarted hub.  With the default
+    ``max_reconnects=0`` faults raise exactly as before.
+
+    ``heartbeat_interval`` (seconds, default off) starts a daemon thread
+    that sends a 13-byte ping whenever the connection has been idle that
+    long with nothing in flight — keeping a slow-but-alive worker (long
+    compile, big window) from tripping the hub's ``idle_timeout``
+    eviction.  Socket sends and reply bookkeeping share one lock so the
+    ping and its ack slot into the reply FIFO without racing the hot path.
+
     Telemetry (client side): ``ps.commit_bytes`` wire bytes,
     ``ps.pull_latency_ms`` / ``ps.commit_latency_ms`` send-to-reply-
     consumed latencies, ``ps.pull_stall_ms`` time actually BLOCKED waiting
     for weights (the post-overlap stall the trainer pays),
     ``ps.serialize_ms`` frame-pack time, ``ps.inflight_depth`` unacked
-    commits."""
+    commits, ``ps.reconnects`` successful reconnections and
+    ``ps.reconnect_ms`` fault-to-reconnected recovery time."""
 
     def __init__(self, host: str, port: int, templates: Sequence[np.ndarray],
                  timeout: Optional[float] = 60.0,
                  compress: Optional[str] = None,
-                 max_inflight: int = 2):
+                 max_inflight: int = 2,
+                 max_reconnects: int = 0,
+                 reconnect_backoff: float = 0.1,
+                 reconnect_backoff_max: float = 5.0,
+                 heartbeat_interval: Optional[float] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -438,22 +767,173 @@ class PSClient:
         # wait_weights (commit_nowait pre-drains them — see below); two
         # landing buffers bound this queue at two entries
         self._ready: Deque[List[np.ndarray]] = deque()
+        self.host, self.port, self.timeout = host, int(port), timeout
+        self.max_reconnects = int(max_reconnects)
+        self.reconnect_backoff = float(reconnect_backoff)
+        self.reconnect_backoff_max = float(reconnect_backoff_max)
+        self.reconnects_used = 0
+        # entropy-seeded ON PURPOSE: the jitter exists so a fleet of
+        # workers severed by one hub restart does NOT retry in lockstep —
+        # a shared deterministic seed would reproduce exactly that herd
+        self._jitter = random.Random()
+        self._closed = False
+        self._consuming = False  # caller blocked in a reply recv
+        # serializes socket SENDS and their _pending bookkeeping between
+        # the caller thread and the heartbeat thread, so the reply FIFO
+        # always matches wire order (receives stay single-threaded: only
+        # the caller consumes).  Without a heartbeat thread the caller is
+        # the ONLY thread touching the socket, so the hot path takes a
+        # no-op guard instead of a real lock — the pipelined exchange pays
+        # nothing for resilience it hasn't enabled
+        self._io_lock = (threading.Lock() if heartbeat_interval is not None
+                         else contextlib.nullcontext())
+        self._last_io = time.monotonic()
         self.sock = net.connect(host, port, timeout=timeout,
                                 payload_hint=self._codec.frame_len)
+        self.heartbeat_interval = (None if heartbeat_interval is None
+                                   else float(heartbeat_interval))
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._ping_frame = net.empty_tensor_frame(net.ACTION_PING)
+        if self.heartbeat_interval is not None:
+            self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                               daemon=True)
+            self._hb_thread.start()
+
+    # -- resilience ------------------------------------------------------------
+    _RETRYABLE = (ConnectionError, OSError, net.ProtocolError)
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.heartbeat_interval
+        while not self._hb_stop.wait(interval / 4.0):
+            with self._io_lock:
+                if self._closed:
+                    return
+                # only ping a genuinely idle connection: traffic in flight
+                # already proves liveness, and interleaving a ping between
+                # a request and its reply is exactly what the FIFO forbids.
+                # _consuming covers the caller mid-receive (it pops the
+                # pending entry BEFORE its blocking recv, so _pending alone
+                # can look empty while the socket is busy) — its rising
+                # edge is serialized with this critical section, so a ping
+                # round trip and a caller recv can never interleave
+                if (self._pending or self._consuming
+                        or time.monotonic() - self._last_io < interval):
+                    continue
+                try:
+                    # the ping's ack is consumed HERE, under the io lock
+                    # (the caller is idle by construction — nothing
+                    # pending — so this thread owns the whole round trip;
+                    # leaving the ack for the caller would stall the next
+                    # ping behind a reply nobody is consuming)
+                    self.sock.sendall(self._ping_frame)
+                    net.recv_action(self.sock)
+                    self._last_io = time.monotonic()
+                except (OSError, ValueError):
+                    # poison the connection: a ping whose ack timed out may
+                    # deliver that ack LATE, and a caller then parsing it
+                    # as its own reply would desync the stream.  Closing
+                    # here turns the caller's next op into a clean
+                    # ConnectionError/EBADF — which reconnects when a
+                    # budget is configured
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+
+    def _resilient(self, op):
+        """Run ``op`` to completion, reconnecting (bounded) across any
+        connection fault.  With ``max_reconnects=0`` the original
+        exception propagates untouched — the pre-resilience contract."""
+        while True:
+            try:
+                return op()
+            except self._RETRYABLE as e:
+                if self._closed or self.max_reconnects <= 0:
+                    raise
+                self._reconnect(e)
+
+    def _reconnect(self, cause: BaseException) -> None:
+        """Tear down the desynchronized connection, back off (exponential +
+        jitter), reconnect, and re-issue any pulls that were in flight —
+        the re-pull observes the (possibly restarted) hub's CURRENT
+        center.  Unacked commits are dropped, not replayed: a commit whose
+        send or ack failed may or may not have been applied, and async SGD
+        tolerates a lost update far better than a doubled one.  Raises
+        ``ConnectionError`` from ``cause`` once the lifetime budget is
+        exhausted."""
+        t_fault = time.perf_counter()
+        # the ENTIRE teardown/backoff/redial runs under the io lock: the
+        # heartbeat thread must neither ping a socket mid-replacement nor
+        # close (its failure path) the freshly reconnected one — and with
+        # no heartbeat the lock is a no-op context, so the common path
+        # pays nothing.  Entered lock-free: every op releases the lock
+        # before its exception reaches _resilient
+        with self._io_lock:
+            lost_pulls = sum(1 for kind, _ in self._pending
+                             if kind == net.ACTION_WEIGHTS)
+            self._pending.clear()
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            while True:
+                if self.reconnects_used >= self.max_reconnects:
+                    raise ConnectionError(
+                        f"PS connection to {self.host}:{self.port} lost and the "
+                        f"reconnect budget ({self.max_reconnects}) is exhausted"
+                    ) from cause
+                self.reconnects_used += 1
+                nominal = min(self.reconnect_backoff
+                              * (2.0 ** (self.reconnects_used - 1)),
+                              self.reconnect_backoff_max)
+                time.sleep(nominal * (0.5 + 0.5 * self._jitter.random()))
+                try:
+                    self.sock = net.connect(self.host, self.port,
+                                            timeout=self.timeout,
+                                            payload_hint=self._codec.frame_len)
+                    # re-pull cleanly INSIDE the attempt: the discarded
+                    # in-flight pulls are re-issued so wait_weights finds
+                    # its reply.  A hub dying again right here must consume
+                    # another budgeted attempt, not escape to the caller —
+                    # this runs inside _resilient's except handler, where a
+                    # raised exception would NOT be re-caught by its loop
+                    for _ in range(lost_pulls):
+                        self.sock.sendall(self._pull_frame)
+                        self._pending.append((net.ACTION_WEIGHTS,
+                                              time.perf_counter()))
+                    self._last_io = time.monotonic()
+                    break
+                except OSError:
+                    # hub still down (or died again mid-re-pull): drop any
+                    # entries from the half-reconnected socket and back
+                    # off further on the next attempt
+                    self._pending.clear()
+                    continue
+        if obs.enabled():
+            obs.counter("ps.reconnects").inc()
+            obs.histogram("ps.reconnect_ms").observe(
+                (time.perf_counter() - t_fault) * 1e3)
 
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
         """Fire a pull request; the reply is consumed later by
         :meth:`wait_weights`.  Issue it while the device computes and the
         weights' wire time hides under the window."""
-        outstanding = (sum(1 for kind, _ in self._pending
-                           if kind == net.ACTION_WEIGHTS) + len(self._ready))
+        with self._io_lock:
+            outstanding = (sum(1 for kind, _ in self._pending
+                               if kind == net.ACTION_WEIGHTS) + len(self._ready))
         if outstanding >= 2:
             raise RuntimeError("at most 2 pulls may be outstanding (two "
                                "landing buffers); claim one with "
                                "wait_weights() first")
-        net.send_raw_frame(self.sock, self._pull_frame)
-        self._pending.append((net.ACTION_WEIGHTS, time.perf_counter()))
+        self._resilient(self._pull_nowait_once)
+
+    def _pull_nowait_once(self) -> None:
+        with self._io_lock:
+            net.send_raw_frame(self.sock, self._pull_frame)
+            self._pending.append((net.ACTION_WEIGHTS, time.perf_counter()))
+            self._last_io = time.monotonic()
 
     def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
         """Send a commit without waiting for its ack (coalesced into a later
@@ -463,73 +943,108 @@ class PSClient:
         # (back-pressure + quantize/pack + send); the ack wait is measured
         # separately by ps.commit_latency_ms when the reply is consumed
         with obs.span("ps.commit", compress=self.compress or "none"):
-            # deadlock avoidance: never start a potentially-blocking large
-            # send while a weights reply may still be in flight — the hub
-            # does not read while it writes, so two big sendalls in
-            # opposite directions can fill both kernel buffers and stall
-            # forever once frames outgrow the socket buffers.  Claim any
-            # pending pull into its landing buffer first (wait_weights
-            # hands it out later); the hub is then parked in recv when the
-            # commit bytes arrive.  This receive time is pull wire-wait,
-            # so it lands in ps.pull_stall_ms like any other pull block.
-            if any(kind == net.ACTION_WEIGHTS for kind, _ in self._pending):
-                t_drain = time.perf_counter() if obs.enabled() else 0.0
-                while any(kind == net.ACTION_WEIGHTS
-                          for kind, _ in self._pending):
-                    self._consume_one()
-                if t_drain:
-                    obs.histogram("ps.pull_stall_ms").observe(
-                        (time.perf_counter() - t_drain) * 1e3)
-            while self._unacked() >= self.max_inflight:
+            self._resilient(lambda: self._commit_nowait_once(delta))
+
+    def _commit_nowait_once(self, delta: Sequence[np.ndarray]) -> None:
+        # deadlock avoidance: never start a potentially-blocking large
+        # send while a weights reply may still be in flight — the hub
+        # does not read while it writes, so two big sendalls in
+        # opposite directions can fill both kernel buffers and stall
+        # forever once frames outgrow the socket buffers.  Claim any
+        # pending pull into its landing buffer first (wait_weights
+        # hands it out later); the hub is then parked in recv when the
+        # commit bytes arrive.  This receive time is pull wire-wait,
+        # so it lands in ps.pull_stall_ms like any other pull block.
+        if self._has_pending(net.ACTION_WEIGHTS):
+            t_drain = time.perf_counter() if obs.enabled() else 0.0
+            while self._has_pending(net.ACTION_WEIGHTS):
                 self._consume_one()
-            telemetry = obs.enabled()
-            t0 = time.perf_counter() if telemetry else 0.0
-            if self.compress == "int8":
-                codec, action = self._q_codec, net.ACTION_QCOMMIT
-                arrays = _quantize_commit(delta, self._residual)
-            else:
-                codec, action = self._codec, net.ACTION_COMMIT
-                arrays = [np.asarray(d, np.float32) for d in delta]
-            codec.pack(action, arrays)
-            if telemetry:
-                obs.histogram("ps.serialize_ms").observe(
-                    (time.perf_counter() - t0) * 1e3)
-                obs.counter("ps.commit_bytes").inc(codec.frame_len)
+            if t_drain:
+                obs.histogram("ps.pull_stall_ms").observe(
+                    (time.perf_counter() - t_drain) * 1e3)
+        while self._unacked() >= self.max_inflight:
+            self._consume_one()
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        if self.compress == "int8":
+            codec, action = self._q_codec, net.ACTION_QCOMMIT
+            # safe across a reconnect retry: the residual chain carries
+            # only ROUNDING error, so re-quantizing the same delta after
+            # a failed (never-applied) send still lands the delta once
+            arrays = _quantize_commit(delta, self._residual)
+        else:
+            codec, action = self._codec, net.ACTION_COMMIT
+            arrays = [np.asarray(d, np.float32) for d in delta]
+        codec.pack(action, arrays)
+        if telemetry:
+            obs.histogram("ps.serialize_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+            obs.counter("ps.commit_bytes").inc(codec.frame_len)
+        with self._io_lock:
             codec.send_packed(self.sock)
             self._pending.append((net.ACTION_ACK, time.perf_counter()))
-            if telemetry:
-                obs.gauge("ps.inflight_depth").set(self._unacked())
+            self._last_io = time.monotonic()
+        if telemetry:
+            obs.gauge("ps.inflight_depth").set(self._unacked())
 
     def wait_weights(self) -> List[np.ndarray]:
         """Hand out the oldest in-flight pull, consuming replies (and any
         commit acks queued ahead of it) as needed."""
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
-        while not self._ready:
-            if not self._pending:
-                raise ConnectionError("wait_weights() with no pull in flight")
-            self._consume_one()
+        self._resilient(self._fill_ready_once)
         if telemetry:
             obs.histogram("ps.pull_stall_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
         return self._ready.popleft()
 
+    def _fill_ready_once(self) -> None:
+        while not self._ready:
+            if not self._pending:
+                # caller bug, not a connection fault (RuntimeError keeps it
+                # out of _RETRYABLE — it must not burn the reconnect
+                # budget; matches InprocPSClient's contract)
+                raise RuntimeError("wait_weights() with no pull in flight")
+            self._consume_one()
+
     def drain(self) -> None:
         """Consume every outstanding reply — trailing commit acks at the end
         of a run, plus any prefetched pull that will go unused."""
-        while self._pending:
-            self._consume_one()
+        self._resilient(self._drain_once)
         self._ready.clear()
         if obs.enabled():
             obs.gauge("ps.inflight_depth").set(0)
 
+    def _drain_once(self) -> None:
+        while self._pending:
+            self._consume_one()
+
+    def _has_pending(self, kind: bytes) -> bool:
+        # snapshot under the io lock: the heartbeat thread appends to
+        # _pending, and a deque must not be iterated during a mutation
+        with self._io_lock:
+            return any(k == kind for k, _ in self._pending)
+
     def _unacked(self) -> int:
-        return sum(1 for kind, _ in self._pending if kind == net.ACTION_ACK)
+        with self._io_lock:
+            return sum(1 for kind, _ in self._pending if kind == net.ACTION_ACK)
 
     def _consume_one(self) -> None:
+        # mark the receive busy UNDER the io lock: if a heartbeat round
+        # trip is in flight we wait for it to finish; once set, the
+        # heartbeat thread will not start another until we clear it
+        with self._io_lock:
+            self._consuming = True
+        try:
+            self._consume_one_inner()
+        finally:
+            self._consuming = False
+
+    def _consume_one_inner(self) -> None:
         kind, t_sent = self._pending.popleft()
         if kind == net.ACTION_ACK:
             reply = net.recv_action(self.sock)
+            self._last_io = time.monotonic()
             if reply != net.ACTION_ACK:
                 raise ConnectionError(f"expected ack, got {reply!r}")
             if obs.enabled():
@@ -539,9 +1054,19 @@ class PSClient:
         else:
             out = self._pull_bufs[self._flip]
             self._flip ^= 1
-            reply = self._codec.recv_into(self.sock, out)
-            if reply != net.ACTION_WEIGHTS:
-                raise ConnectionError(f"expected weights reply, got {reply!r}")
+            try:
+                reply = self._codec.recv_into(self.sock, out)
+                if reply != net.ACTION_WEIGHTS:
+                    raise ConnectionError(f"expected weights reply, got {reply!r}")
+            except Exception:
+                # the receive died mid-weights: restore the entry (and the
+                # landing buffer) so a reconnect counts this pull as lost
+                # and re-issues it — without this, wait_weights retried
+                # after a mid-frame fault would find "no pull in flight"
+                self._flip ^= 1
+                self._pending.appendleft((kind, t_sent))
+                raise
+            self._last_io = time.monotonic()
             self._ready.append(out)
             if obs.enabled():
                 obs.histogram("ps.pull_latency_ms").observe(
@@ -558,6 +1083,10 @@ class PSClient:
         self.drain()
 
     def close(self) -> None:
+        self._closed = True
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
         try:
             net.send_raw_frame(self.sock, net.empty_tensor_frame(net.ACTION_BYE))
         except OSError:

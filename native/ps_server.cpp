@@ -17,6 +17,7 @@
 //            'C' commit (center-shaped f32 deltas) -> 'A'
 //            'Q' int8 commit (per tensor: be f32 scale + int8 values,
 //                dequantized here, then the same scaling rules) -> 'A'
+//            'H' heartbeat (liveness proof while idle) -> 'A'
 //            'B' bye -> connection closes
 //
 // Commit scaling modes (matching runtime/parameter_server.py):
@@ -39,8 +40,6 @@
 #include <vector>
 
 namespace {
-
-constexpr uint64_t kMaxFrame = 1ULL << 34;  // 16 GiB, matches MAX_FRAME
 
 uint64_t be64_decode(const unsigned char* b) {
   uint64_t v = 0;
@@ -84,12 +83,22 @@ bool write_all(int fd, const void* buf, size_t n) {
 
 class ParameterServer {
  public:
-  ParameterServer(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers)
-      : requested_port_(port), mode_(mode), num_workers_(num_workers) {
+  ParameterServer(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers,
+                  int elastic, int idle_timeout_ms)
+      : requested_port_(port), mode_(mode), num_workers_(num_workers),
+        elastic_(elastic != 0), idle_timeout_ms_(idle_timeout_ms) {
     sizes_.assign(sizes, sizes + num_tensors);
     int64_t total = 0;
     for (int64_t s : sizes_) total += s;
     center_.assign(size_t(total), 0.0f);
+    // largest VALID payload a peer may declare: per tensor the larger of
+    // the f32 blob (4*size) and the int8 Q blob (4+size, bigger for
+    // scalar leaves).  recv_payload caps against this, so a garbage
+    // length prefix is a dropped connection, not a multi-GiB resize
+    // (matching the Python hub's _max_payload)
+    max_payload_ = 5;
+    for (int64_t s : sizes_)
+      max_payload_ += 8 + uint64_t(std::max(s * int64_t(sizeof(float)), 4 + s));
   }
 
   ~ParameterServer() { stop(); }
@@ -149,6 +158,18 @@ class ParameterServer {
   int64_t num_updates() const { return num_updates_.load(); }
   int port() const { return bound_port_; }
 
+  // restore a hub snapshot: center + commit clock + update count, with the
+  // clock FENCE armed at the restored clock so any pre-restart pull clock
+  // a caller presents is clamped to the restart point (matching the
+  // Python hub's restore_state semantics)
+  void restore(const float* flat, int64_t clock, int64_t num_updates) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(center_.data(), flat, center_.size() * sizeof(float));
+    clock_ = clock;
+    clock_fence_ = clock;
+    num_updates_.store(num_updates);
+  }
+
   // -- in-process transport (transport="inproc") ------------------------------
   // The direct-call twins of the 'P' and 'C' wire branches: co-located
   // Python workers (ctypes releases the GIL for the call) snapshot and
@@ -167,6 +188,7 @@ class ParameterServer {
     for (size_t i = 0; i < sizes_.size(); ++i) { delta[i] = p; p += sizes_[i]; }
     {
       std::lock_guard<std::mutex> g(center_mutex_);
+      if (last_pull_clock < clock_fence_) last_pull_clock = clock_fence_;
       apply_commit(delta.data(), clock_ - last_pull_clock);
       ++clock_;
     }
@@ -188,6 +210,21 @@ class ParameterServer {
       int bufsz = int(std::min<int64_t>(std::max<int64_t>(want, 64 << 10), 8 << 20));
       ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
       ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+      if (idle_timeout_ms_ > 0) {
+        // half-open liveness: a peer that dies without FIN must not park
+        // this handler in recv() forever — the timed-out recv reads as a
+        // dead peer and the connection is evicted (clients heartbeat on
+        // idle to prove liveness; matches the Python hub's idle_timeout)
+        timeval tv{};
+        tv.tv_sec = idle_timeout_ms_ / 1000;
+        tv.tv_usec = (idle_timeout_ms_ % 1000) * 1000;
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        // and sends: a half-open peer with a full TCP window must not
+        // park the handler (and its membership slot) in write_all for
+        // the kernel's multi-minute retransmission timeout — Python's
+        // conn.settimeout() bounds both directions, so match it
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      }
       std::lock_guard<std::mutex> g(conn_mutex_);
       conn_fds_.push_back(fd);
       handler_threads_.emplace_back([this, fd] { handle_connection(fd); });
@@ -198,7 +235,7 @@ class ParameterServer {
     unsigned char hdr[8];
     if (!read_exact(fd, hdr, 8)) return false;
     uint64_t n = be64_decode(hdr);
-    if (n > kMaxFrame) return false;
+    if (n > max_payload_) return false;  // garbage/oversized prefix: drop peer
     payload.resize(size_t(n));
     return n == 0 || read_exact(fd, payload.data(), size_t(n));
   }
@@ -282,10 +319,24 @@ class ParameterServer {
     return off == payload.size();
   }
 
+  // called under center_mutex_ (live_members_ shares that lock)
   void apply_commit(const float** delta, int64_t staleness) {
     float scale = 1.0f;
-    if (mode_ == 1) scale = 1.0f / float(num_workers_);
-    else if (mode_ == 2) scale = 1.0f / float(staleness + 1);
+    if (mode_ == 1) {
+      int n = num_workers_;
+      if (elastic_) {
+        // elastic ADAG: normalize by the LIVE committer count (join on
+        // first commit, leave at disconnect/eviction), clamped to
+        // num_workers — a permanently dead worker stops diluting the
+        // survivors' deltas.  Zero members means this commit came via
+        // commit_direct (inproc bypasses connections): fall back to the
+        // static denominator, never to 1/1
+        n = live_members_;
+        if (n < 1) n = num_workers_;
+        if (n > num_workers_) n = num_workers_;
+      }
+      scale = 1.0f / float(n);
+    } else if (mode_ == 2) scale = 1.0f / float(staleness + 1);
     float* c = center_.data();
     for (size_t i = 0; i < sizes_.size(); ++i) {
       const float* d = delta[i];
@@ -296,7 +347,15 @@ class ParameterServer {
   }
 
   void handle_connection(int fd) {
-    int64_t last_pull_clock = 0;
+    int64_t last_pull_clock;
+    {
+      // connections born after a restore start AT the fence: a commit
+      // before the first pull is stale relative to the restart point,
+      // not to clock zero of a previous incarnation
+      std::lock_guard<std::mutex> g(center_mutex_);
+      last_pull_clock = clock_fence_;
+    }
+    bool joined = false;
     std::vector<unsigned char> payload;
     std::vector<const float*> delta(sizes_.size());
     std::vector<float> qbuf;
@@ -319,14 +378,26 @@ class ParameterServer {
                           : !parse_qcommit(payload, qbuf, delta.data())) break;
         {
           std::lock_guard<std::mutex> g(center_mutex_);
+          if (!joined) {
+            // first commit = this peer is a worker (pull-only readers
+            // never join); membership drives the elastic denominator
+            joined = true;
+            ++live_members_;
+          }
           apply_commit(delta.data(), clock_ - last_pull_clock);
           ++clock_;
         }
         num_updates_.fetch_add(1);
         if (!send_simple(fd, 'A')) break;
+      } else if (action == 'H') {  // heartbeat: liveness proof, acked
+        if (!send_simple(fd, 'A')) break;
       } else {  // 'B' or unknown -> close
         break;
       }
+    }
+    if (joined) {
+      std::lock_guard<std::mutex> g(center_mutex_);
+      --live_members_;
     }
     ::close(fd);
     // forget the fd so stop() can't shutdown() a future unrelated socket
@@ -339,10 +410,15 @@ class ParameterServer {
   int bound_port_ = -1;
   int mode_;
   int num_workers_;
+  bool elastic_;
+  int idle_timeout_ms_;
+  uint64_t max_payload_ = 0;
+  int live_members_ = 0;  // guarded by center_mutex_
   std::vector<int64_t> sizes_;
   std::vector<float> center_;
   std::mutex center_mutex_;
   int64_t clock_ = 0;
+  int64_t clock_fence_ = 0;  // guarded by center_mutex_; armed by restore()
   std::atomic<int64_t> num_updates_{0};
   std::atomic<bool> running_{false};
   int listen_fd_ = -1;
@@ -356,8 +432,10 @@ class ParameterServer {
 
 extern "C" {
 
-void* dk_ps_create(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers) {
-  return new ParameterServer(port, num_tensors, sizes, mode, num_workers);
+void* dk_ps_create(int port, int num_tensors, const int64_t* sizes, int mode, int num_workers,
+                   int elastic, int idle_timeout_ms) {
+  return new ParameterServer(port, num_tensors, sizes, mode, num_workers, elastic,
+                             idle_timeout_ms);
 }
 
 int dk_ps_start(void* ps) { return static_cast<ParameterServer*>(ps)->start(); }
@@ -369,6 +447,9 @@ int dk_ps_port(void* ps) { return static_cast<ParameterServer*>(ps)->port(); }
 int64_t dk_ps_pull(void* ps, float* out) { return static_cast<ParameterServer*>(ps)->pull_direct(out); }
 void dk_ps_commit(void* ps, const float* flat, int64_t last_pull_clock) {
   static_cast<ParameterServer*>(ps)->commit_direct(flat, last_pull_clock);
+}
+void dk_ps_restore(void* ps, const float* flat, int64_t clock, int64_t num_updates) {
+  static_cast<ParameterServer*>(ps)->restore(flat, clock, num_updates);
 }
 void dk_ps_destroy(void* ps) { delete static_cast<ParameterServer*>(ps); }
 

@@ -226,6 +226,43 @@ def test_async_acceptance_block_tripwires():
     assert acc2["final_loss_parity"] is None
 
 
+def test_async_recovery_acceptance_block_tripwires():
+    """The issue-4 recovery acceptance block: recovered/parity booleans,
+    with None (not a crash) wherever a denominator leg errored out."""
+    out = {
+        "fault_free": {"wall_s": 10.0, "final_loss": 2.0},
+        "sever": {"wall_s": 14.0, "final_loss": 2.1, "faults_fired": 2,
+                  "reconnects": 2.0, "recovery_ms": {"count": 2}},
+        "worker_restart": {"wall_s": 13.0, "final_loss": 2.05,
+                           "kills_fired": 1, "restarts": 1,
+                           "worker_errors": 0},
+    }
+    bench._async_recovery_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["sever_recovered_ok"] is True
+    assert acc["sever_loss_abs_diff"] == 0.1
+    assert acc["sever_loss_tol"] == 0.3  # max(0.05, 0.15 * 2.0)
+    assert acc["sever_loss_parity_ok"] is True
+    assert acc["worker_restart_ok"] is True
+    assert acc["restart_loss_parity_ok"] is True
+
+    # a dead fault-free denominator degrades parity to None, and a dead
+    # chaos leg degrades its own tripwires — nothing raises
+    out2 = {
+        "fault_free": {"error": "RuntimeError: device fell over"},
+        "sever": {"error": "ConnectionError: proxy died"},
+        "worker_restart": {"wall_s": 13.0, "final_loss": 2.05,
+                           "kills_fired": 1, "restarts": 1,
+                           "worker_errors": 0},
+    }
+    bench._async_recovery_acceptance(out2)
+    acc2 = out2["acceptance"]
+    assert acc2["sever_recovered_ok"] is None
+    assert acc2["sever_loss_parity_ok"] is None
+    assert acc2["worker_restart_ok"] is True
+    assert acc2["restart_loss_parity_ok"] is None
+
+
 @pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
 def test_moe_acceptance_block_shape():
     """The issue-2 tripwire block: booleans (or None off-TPU) with the

@@ -101,3 +101,76 @@ def test_distributed_trainer_resume_bit_exact(tmp_path, toy_dataset):
     make(1).train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
     resumed = make(2).train(toy_dataset, checkpointer=Checkpointer(ckpt_dir))
     tree_equal(straight.params, resumed.params)
+
+
+# -- corrupt-snapshot hardening (issue 4 satellite) ----------------------------
+
+def _save_steps(tmp_path, steps, keep=5):
+    ckpt = Checkpointer(str(tmp_path), keep=keep)
+    for step in steps:
+        ckpt.save(step, {"t": {"x": np.full(2, step, np.float32)}},
+                  metadata={"step": step})
+    return ckpt
+
+
+def _corrupt(tmp_path, step, how):
+    d = os.path.join(str(tmp_path), f"step_{step:010d}")
+    if how == "npz":
+        with open(os.path.join(d, "t.npz"), "wb") as f:
+            f.write(b"definitely not a zipfile")
+    elif how == "meta":
+        with open(os.path.join(d, "checkpoint.json"), "w") as f:
+            f.write("{ torn json")
+    elif how == "missing":
+        os.remove(os.path.join(d, "t.npz"))
+
+
+@pytest.mark.parametrize("how", ["npz", "meta", "missing"])
+def test_restore_skips_corrupt_latest_with_warning(tmp_path, how):
+    """A torn latest checkpoint (truncated npz, torn manifest, missing
+    member — corruption the atomic rename cannot defend against) is
+    skipped with a warning and the previous good one is restored."""
+    ckpt = _save_steps(tmp_path, [1, 2])
+    _corrupt(tmp_path, 2, how)
+    with pytest.warns(UserWarning, match="skipping corrupt"):
+        out = ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
+    np.testing.assert_array_equal(out["t"]["x"], np.full(2, 1.0))
+
+
+def test_restore_explicit_corrupt_step_raises(tmp_path):
+    """Naming a step explicitly must NOT silently substitute an older one."""
+    ckpt = _save_steps(tmp_path, [1, 2])
+    _corrupt(tmp_path, 2, "npz")
+    with pytest.raises(Exception):
+        ckpt.restore({"t": {"x": np.zeros(2, np.float32)}}, step=2)
+    # the latest-path still degrades gracefully afterwards
+    with pytest.warns(UserWarning):
+        out = ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
+    np.testing.assert_array_equal(out["t"]["x"], np.full(2, 1.0))
+
+
+def test_restore_all_corrupt_raises_with_cause(tmp_path):
+    ckpt = _save_steps(tmp_path, [1, 2])
+    _corrupt(tmp_path, 1, "npz")
+    _corrupt(tmp_path, 2, "meta")
+    with pytest.warns(UserWarning):
+        with pytest.raises(FileNotFoundError, match="all corrupt"):
+            ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
+
+
+def test_retention_still_applies_around_corrupt_steps(tmp_path):
+    """Retention is by step order, corrupt or not: saving past keep evicts
+    the oldest (including a corrupt one) and the survivors stay loadable."""
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    for step in [1, 2, 3]:
+        ckpt.save(step, {"t": {"x": np.full(2, step, np.float32)}})
+    _corrupt(tmp_path, 3, "npz")
+    ckpt.save(4, {"t": {"x": np.full(2, 4.0, np.float32)}})
+    assert ckpt.all_steps() == [3, 4]
+    out = ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
+    np.testing.assert_array_equal(out["t"]["x"], np.full(2, 4.0))
+    # kill the good latest too: fallback crosses the corrupt step-3
+    _corrupt(tmp_path, 4, "npz")
+    with pytest.warns(UserWarning):
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore({"t": {"x": np.zeros(2, np.float32)}})
