@@ -1661,6 +1661,138 @@ def _async_recovery_acceptance(out: dict) -> None:
     }
 
 
+def _bench_observability(*, workers: int = 2, window: int = 8, batch: int = 256,
+                         windows_per_epoch: int = 8, epochs: int = 3,
+                         reps: int = 3):
+    """Issue-5 observability leg: what does fleet-wide tracing COST, and
+    does the attribution pipeline actually work end to end?
+
+    Two sub-legs on the headline async config (AsyncADAG, python hub,
+    pipelined sockets):
+
+    - ``telemetry_off`` vs ``telemetry_on``: the same warmed trainer timed
+      with telemetry disabled and then fully enabled (registry + spans +
+      per-worker trace contexts + end-of-run trace flush to a temp
+      ``DKT_TRACE_DIR``).  ``overhead_pct`` is the median-of-``reps``
+      relative wall cost — the <3% acceptance target.  No profiler here:
+      the leg measures telemetry's own tax, nothing else's.
+    - the on-leg's flushed trace is merged (``merge_traces``) and
+      ``fleet_report`` runs over it: the leg records hub-commit context
+      coverage (the >=95% acceptance criterion) and whether a straggler
+      ranking came back.
+    """
+    import os as _os
+    import tempfile
+
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.observability import distributed as dtrace
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import mnist_cnn_spec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+
+    spec = mnist_cnn_spec()
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    ds = Dataset({
+        "features": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+        "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)],
+    })
+    kwargs = dict(loss="categorical_crossentropy", batch_size=batch,
+                  num_epoch=epochs, learning_rate=0.01, seed=0,
+                  num_workers=workers, communication_window=window)
+
+    tr = AsyncADAG(Model.init(spec, seed=0), **kwargs)
+    tr.train(ds, shuffle=False)  # compile + warm
+
+    def timed(telemetry: bool, trace_dir=None):
+        walls = []
+        for _ in range(reps):
+            tr.model = Model.init(spec, seed=0)
+            tr.history = []
+            if telemetry:
+                obs.enable()
+                obs.reset()
+                # one rep = one job's evidence: earlier reps' flushed
+                # files must not stack up as phantom extra "processes"
+                # in the merged trace
+                if trace_dir is not None:
+                    import glob as _glob
+
+                    for f in _glob.glob(_os.path.join(trace_dir,
+                                                      "trace-*.jsonl")):
+                        _os.remove(f)
+            else:
+                # the off leg must actually be OFF even when the operator
+                # exported DKT_TELEMETRY=1 (the documented enable path) —
+                # otherwise overhead_pct compares on vs on and reads ~0
+                obs.disable()
+            t0 = time.perf_counter()
+            tr.train(ds, shuffle=False)
+            walls.append(time.perf_counter() - t0)
+            if telemetry:
+                obs.disable()
+        return float(np.median(walls))
+
+    was_enabled = obs.enabled()
+    out = {"workers": workers, "window": window, "batch": batch,
+           "epochs": epochs, "reps": reps, "timing": "wall-median"}
+    wall_off = timed(False)
+    out["telemetry_off"] = {"wall_s": round(wall_off, 3)}
+
+    with tempfile.TemporaryDirectory() as td:
+        old_dir = _os.environ.get("DKT_TRACE_DIR")
+        _os.environ["DKT_TRACE_DIR"] = td
+        try:
+            wall_on = timed(True, trace_dir=td)
+        finally:
+            if old_dir is None:
+                _os.environ.pop("DKT_TRACE_DIR", None)
+            else:
+                _os.environ["DKT_TRACE_DIR"] = old_dir
+            if was_enabled:
+                obs.enable()
+        merged = dtrace.merge_traces(td)
+        report = dtrace.fleet_report(trace_dir=td)
+    out["telemetry_on"] = {"wall_s": round(wall_on, 3)}
+    out["overhead_pct"] = round((wall_on / wall_off - 1.0) * 100.0, 2)
+    out["merged_trace"] = {
+        "processes": merged["otherData"]["processes"],
+        "spans": merged["otherData"]["spans"],
+        "alignment_error_us": merged["otherData"]["alignment_error_us"],
+    }
+    out["fleet"] = {
+        "commit_context_coverage": report["commit_context_coverage"],
+        "total_commits": report["total_commits"],
+        "top_straggler": report["top_straggler"],
+        "workers_seen": len(report["workers"]),
+    }
+    _observability_acceptance(out)
+    return out
+
+
+def _observability_acceptance(out: dict) -> None:
+    """Attach the issue-5 tripwires, in place: tracing overhead under the
+    3% target, and >=95% of hub commit spans carrying a worker trace
+    context.  Booleans, or None when a leg is missing/errored (graceful
+    degradation, the PR-3 convention)."""
+    overhead = out.get("overhead_pct")
+    coverage = (out.get("fleet") or {}).get("commit_context_coverage")
+    out["acceptance"] = {
+        "overhead_pct": overhead,
+        "overhead_pct_target": 3.0,
+        "overhead_ok": None if overhead is None else bool(overhead < 3.0),
+        "commit_context_coverage": coverage,
+        "coverage_target": 0.95,
+        "coverage_ok": None if coverage is None else bool(coverage >= 0.95),
+        "straggler_ranked": (bool((out.get("fleet") or {}).get("top_straggler")
+                                  is not None)
+                             if isinstance(out.get("fleet"), dict) else None),
+    }
+
+
 def _leg_ratio(current: float, base: float):
     """current/base rounded, or None when either side is missing/zero."""
     if not current or not base:
@@ -1886,6 +2018,11 @@ def main() -> None:
                 out["async_recovery"] = _bench_async_recovery()
             except Exception as e:
                 out["async_recovery"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["observability"] = _bench_observability()
+            except Exception as e:
+                out["observability"] = {"error": f"{type(e).__name__}: {e}"}
             _apply_leg_baselines(out, baseline)
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,

@@ -8,7 +8,15 @@ async engine, feed path, MoE router, punchcard daemon):
   log-bucket histograms; thread-safe; near-zero cost while disabled.
 - :mod:`.tracing` — context-manager spans in a bounded ring buffer,
   exportable as Chrome ``trace_event`` JSON and JSONL.
-- :mod:`.sinks` — periodic JSONL flusher + Prometheus text exposition.
+- :mod:`.sinks` — periodic JSONL flusher + Prometheus text exposition
+  (label values escaped per the text-format spec).
+- :mod:`.distributed` — fleet-wide tracing (ISSUE #5): per-worker
+  :class:`~.distributed.TraceContext` propagated over the PS wire,
+  NTP-style clock alignment from PS round trips,
+  :func:`~.distributed.merge_traces` (one Chrome trace for a whole job)
+  and :func:`~.distributed.fleet_report` (straggler + staleness
+  attribution).  Exposed lazily here (``obs.TraceContext`` etc.) so
+  importing the package stays dependency- and cycle-free.
 
 Telemetry is **disabled by default** (instrumented call sites cost one
 branch).  Turn it on with :func:`enable` — or set ``DKT_TELEMETRY=1`` in
@@ -101,6 +109,31 @@ def reset() -> None:
     """Drop all recorded metrics and spans (enabled flags unchanged)."""
     REGISTRY.reset()
     TRACER.clear()
+
+
+# lazy access to the distributed-tracing layer (PEP 562): obs.TraceContext,
+# obs.merge_traces(...), obs.fleet_report(...) resolve on first touch so the
+# package import graph stays acyclic (distributed imports obs helpers back)
+_DISTRIBUTED_EXPORTS = (
+    "TraceContext", "new_span_id", "new_job_id", "activate", "deactivate",
+    "current", "current_span_attrs", "record_clock_sync", "clock_sync_state",
+    "flush_process_trace", "merge_traces", "export_merged", "load_trace_dir",
+    "fleet_report",
+)
+
+
+def __getattr__(name: str):
+    if name == "distributed" or name in _DISTRIBUTED_EXPORTS:
+        import importlib
+
+        # importlib (not ``from ... import``): the from-import machinery
+        # resolves the submodule THROUGH this very __getattr__ before it
+        # exists as an attribute, which would recurse forever
+        distributed = importlib.import_module(
+            "distkeras_tpu.observability.distributed")
+        globals()["distributed"] = distributed
+        return distributed if name == "distributed" else getattr(distributed, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 if os.environ.get("DKT_TELEMETRY", "").strip().lower() in ("1", "true", "on", "yes"):

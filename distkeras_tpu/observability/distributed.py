@@ -1,0 +1,408 @@
+"""Fleet-wide distributed tracing: context propagation, clock-aligned
+trace merge, and straggler/staleness attribution.
+
+PR 1's telemetry is strictly per-process: a worker's ``ps.pull_latency_ms``
+and the hub's ``ps_commit_staleness`` cannot be joined into one causal
+picture.  The paper lineage demands exactly that join — "How to scale
+distributed deep learning?" (arXiv:1611.04581) attributes async-SGD
+quality loss to *per-worker* staleness and stragglers, and elastic-PS work
+(arXiv:2204.03211) makes membership churn a first-class signal.  This
+module is the cross-process layer:
+
+- :class:`TraceContext` — a ``(job_id, worker_id, span_id)`` identity each
+  worker announces over the PS protocol (wire action ``T``,
+  :mod:`distkeras_tpu.runtime.networking`), so hub-side spans
+  (``ps.handle_commit``, ``ps.handle_pull``, snapshot, eviction) are
+  attributable to the worker that caused them.  The context is carried
+  thread-locally (:func:`activate` / :func:`current`) because async
+  workers are threads of one process.
+- **Clock alignment** — every process traces on its own monotonic clock
+  (``time.perf_counter_ns``).  Worker processes estimate their offset to
+  the hub's clock from the ``T`` announce round trips, NTP-style: the hub
+  stamps its clock into the reply, and ``offset = hub_ts - (t0 + t1)/2``
+  with error bound ``rtt/2`` for the minimum-RTT sample
+  (:func:`record_clock_sync` keeps the best estimate per process).
+- :func:`flush_process_trace` / :func:`merge_traces` — each process
+  flushes its span ring as JSONL (one ``meta`` line with the offset
+  estimate, then one line per span); the merge shifts every process onto
+  the hub timeline and emits one Chrome trace with per-process tracks.
+
+  **Alignment-error bound** (documented contract): a merged timestamp is
+  off the hub timeline by at most its process's ``clock_error_ns``
+  (= min-RTT/2 of its sync samples), so the relative error between spans
+  of two processes is bounded by the SUM of their two error bounds —
+  ``merge_traces`` reports the per-process bounds and their max in
+  ``otherData``.  Same-process spans keep exact relative order (one
+  clock, one shift).
+- :func:`fleet_report` — joins hub commit records (per-commit staleness,
+  attributed worker) with worker window spans to rank stragglers,
+  attribute ADAG/DynSGD staleness to specific workers, and flag reconnect
+  storms.  Exposed remotely via the punchcard ``telemetry`` action
+  (``fetch_telemetry(..., fleet=True)``).
+
+Dependency-free (stdlib only) and import-cycle-free: this module imports
+only its :mod:`.metrics`/:mod:`.tracing` siblings; the runtime imports it,
+never the reverse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import random
+import socket as _socket
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "TraceContext", "new_span_id", "new_job_id",
+    "activate", "deactivate", "current", "current_span_attrs",
+    "record_clock_sync", "clock_sync_state", "reset_clock_sync",
+    "flush_process_trace", "merge_traces", "export_merged", "load_trace_dir",
+    "fleet_report",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Per-worker trace identity, announced once per PS connection (wire
+    action ``T``) and attached to both ends' spans.  ``worker_id`` is the
+    worker ordinal within the job; ``span_id`` is a random 63-bit id that
+    distinguishes two incarnations of the same worker (a supervisor
+    restart gets a fresh ``span_id``)."""
+
+    job_id: str
+    worker_id: int
+    span_id: int
+
+    def to_json(self) -> str:
+        return json.dumps({"job_id": self.job_id,
+                           "worker_id": int(self.worker_id),
+                           "span_id": int(self.span_id)})
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "TraceContext":
+        d = json.loads(raw if isinstance(raw, str) else bytes(raw).decode("utf-8"))
+        return cls(job_id=str(d.get("job_id", "")),
+                   worker_id=int(d.get("worker_id", -1)),
+                   span_id=int(d.get("span_id", 0)))
+
+    def span_attrs(self) -> Dict[str, Any]:
+        """The attrs every span tagged with this context carries."""
+        return {"job": self.job_id, "worker": int(self.worker_id),
+                "ctx_span": int(self.span_id)}
+
+
+def new_span_id() -> int:
+    return random.getrandbits(63)
+
+
+def new_job_id() -> str:
+    """A fresh job id: short, unique enough for one trace directory."""
+    return f"job-{random.getrandbits(32):08x}"
+
+
+# -- thread-local context (workers are threads of one process) -----------------
+
+_tls = threading.local()
+_process_default: Optional[TraceContext] = None
+
+
+def activate(ctx: Optional[TraceContext], process_default: bool = False) -> None:
+    """Bind ``ctx`` to the calling thread (and optionally as the process
+    fallback for threads that never activate one)."""
+    global _process_default
+    _tls.ctx = ctx
+    if process_default:
+        _process_default = ctx
+
+
+def deactivate() -> None:
+    _tls.ctx = None
+
+
+def current() -> Optional[TraceContext]:
+    """The calling thread's context, falling back to the process default.
+    Hub code running IN a worker's thread (the inproc transport's
+    ``commit_direct``) reads the committing worker's identity here."""
+    return getattr(_tls, "ctx", None) or _process_default
+
+
+def current_span_attrs() -> Dict[str, Any]:
+    ctx = current()
+    return ctx.span_attrs() if ctx is not None else {}
+
+
+# -- clock sync (process-local best estimate) ----------------------------------
+
+_clock_lock = threading.Lock()
+_clock_offset_ns = 0
+_clock_error_ns: Optional[int] = None
+
+
+def record_clock_sync(offset_ns: int, error_ns: int) -> None:
+    """Record one NTP-style offset estimate (local -> hub timeline:
+    ``t_hub = t_local + offset_ns``; ``error_ns`` = rtt/2 of the sample).
+    The process keeps the LOWEST-error estimate seen — every PSClient in
+    the process syncs, and the tightest round trip wins."""
+    global _clock_offset_ns, _clock_error_ns
+    with _clock_lock:
+        if _clock_error_ns is None or error_ns < _clock_error_ns:
+            _clock_offset_ns = int(offset_ns)
+            _clock_error_ns = int(error_ns)
+
+
+def clock_sync_state() -> Tuple[int, Optional[int]]:
+    """(best offset_ns, its error_ns or None if never synced)."""
+    with _clock_lock:
+        return _clock_offset_ns, _clock_error_ns
+
+
+def reset_clock_sync() -> None:
+    global _clock_offset_ns, _clock_error_ns
+    with _clock_lock:
+        _clock_offset_ns, _clock_error_ns = 0, None
+
+
+# -- per-process trace flush ---------------------------------------------------
+
+def flush_process_trace(directory: str, job_id: Optional[str] = None,
+                        role: str = "process",
+                        tracer: Any = None) -> str:
+    """Write this process's span ring to ``directory`` as one JSONL file:
+    first a ``{"kind": "meta", ...}`` line (pid, role, clock offset +
+    error bound), then one ``{"kind": "span", ...}`` line per recorded
+    span (timestamps stay on the LOCAL monotonic clock; the merge applies
+    the offset).  Returns the written path.  The ``DKT_TRACE_DIR``
+    environment knob points trainers and the standalone hub daemon here.
+    """
+    if tracer is None:
+        from distkeras_tpu import observability as _obs
+
+        tracer = _obs.TRACER
+    os.makedirs(directory, exist_ok=True)
+    offset_ns, error_ns = clock_sync_state()
+    pid = os.getpid()
+    host = _socket.gethostname()
+    meta = {
+        "kind": "meta",
+        "pid": pid,
+        "role": role,
+        "job_id": job_id,
+        "host": host,
+        "clock_offset_ns": offset_ns,
+        "clock_error_ns": error_ns,
+        "wall_time": time.time(),
+        "dropped_spans": getattr(tracer, "dropped", 0),
+    }
+    # hostname in the name: a shared multi-host trace dir must never let
+    # two hosts with colliding PIDs overwrite each other's flush
+    safe_host = "".join(c if c.isalnum() or c in "-_" else "_" for c in host)
+    path = os.path.join(
+        directory, f"trace-{job_id or 'nojob'}-{role}-{safe_host}-{pid}.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        for event in tracer.events():
+            f.write(json.dumps(dict(event, kind="span")) + "\n")
+    return path
+
+
+# -- clock-aligned merge -------------------------------------------------------
+
+def load_trace_dir(directory: str) -> Tuple[List[Dict[str, Any]],
+                                            List[Dict[str, Any]]]:
+    """Read every ``trace-*.jsonl`` under ``directory``: returns
+    ``(metas, spans)`` where each span is tracer-shaped (``name``,
+    ``ts_us``, ``dur_us``, ``tid``, ``attrs``) with its timestamps ALREADY
+    shifted onto the hub timeline and a ``pid`` track key attached.  The
+    track key is the file's ORDINAL, not the OS pid — two hosts flushing
+    into one shared dir may collide on raw pids, and each file must stay
+    its own track.  Unreadable lines are skipped (a process killed
+    mid-flush loses only its own tail)."""
+    metas: List[Dict[str, Any]] = []
+    spans: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(directory, "trace-*.jsonl"))):
+        meta: Dict[str, Any] = {"role": "unknown"}
+        file_spans: List[Dict[str, Any]] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a crashed flush
+                if rec.get("kind") == "meta":
+                    meta = rec
+                elif rec.get("kind") == "span":
+                    file_spans.append(rec)
+        off_us = int(meta.get("clock_offset_ns") or 0) // 1000
+        track = len(metas)
+        for s in file_spans:
+            s = dict(s)
+            s["ts_us"] = int(s["ts_us"]) + off_us
+            s["pid"] = track
+            spans.append(s)
+        meta = dict(meta, path=path, span_count=len(file_spans), track=track)
+        metas.append(meta)
+    return metas, spans
+
+
+def merge_traces(directory: str) -> Dict[str, Any]:
+    """One clock-aligned Chrome ``trace_event`` object for a whole job:
+    every process flushed by :func:`flush_process_trace` becomes a track
+    (``pid``), threads within it stay separate ``tid`` lanes, and all
+    timestamps are shifted onto the hub timeline by each process's
+    recorded offset.  ``otherData.alignment_error_us`` documents the
+    worst-case single-process error bound (see module docstring for the
+    pairwise bound — the sum of the two processes' bounds)."""
+    metas, spans = load_trace_dir(directory)
+    events: List[Dict[str, Any]] = []
+    for meta in metas:
+        label = f"{meta.get('role', 'process')}"
+        if meta.get("job_id"):
+            label += f" {meta['job_id']}"
+        if meta.get("host"):
+            label += f" {meta['host']}"
+        label += f" (pid {meta.get('pid', '?')})"
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": meta.get("track"), "tid": 0,
+                       "args": {"name": label}})
+    span_events = []
+    for s in spans:
+        span_events.append({
+            "name": s["name"],
+            "ph": "X",
+            "ts": s["ts_us"],
+            "dur": s.get("dur_us", 0),
+            "pid": s["pid"],
+            "tid": s.get("tid", 0),
+            "args": dict(s.get("attrs") or {}, depth=s.get("depth", 0),
+                         thread=s.get("thread", "")),
+        })
+    span_events.sort(key=lambda e: e["ts"])
+    errors = {m.get("track"): m.get("clock_error_ns")
+              for m in metas if m.get("clock_error_ns") is not None}
+    max_err_ns = max(errors.values(), default=0)
+    return {
+        "traceEvents": events + span_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "processes": len(metas),
+            "spans": len(span_events),
+            "clock_error_ns_by_track": errors,
+            "alignment_error_us": max_err_ns // 1000,
+        },
+    }
+
+
+def export_merged(directory: str, path: str) -> str:
+    """Write :func:`merge_traces`' Chrome trace to ``path``."""
+    with open(path, "w") as f:
+        json.dump(merge_traces(directory), f)
+    return path
+
+
+# -- straggler + staleness attribution -----------------------------------------
+
+def _span_records(events: Optional[Iterable[Dict[str, Any]]],
+                  trace_dir: Optional[str]) -> List[Dict[str, Any]]:
+    if events is not None:
+        return list(events)
+    if trace_dir:
+        metas, spans = load_trace_dir(trace_dir)
+        if metas:
+            return spans
+        # the dir exists but nothing has flushed yet (processes flush at
+        # END of run): fall back to this process's live ring so mid-job
+        # pulls (punchcard fleet=True) still report
+    from distkeras_tpu import observability as _obs
+
+    return _obs.TRACER.events()
+
+
+def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
+                 trace_dir: Optional[str] = None,
+                 storm_threshold: int = 3) -> Dict[str, Any]:
+    """Join hub commit records with worker window spans into one
+    per-worker attribution table.
+
+    Sources (first match wins): explicit tracer-shaped ``events``, a
+    flushed ``trace_dir`` (clock-aligned across processes), else this
+    process's live span ring.  Consumes:
+
+    - ``async.window`` spans (worker attr) -> straggler ranking by mean
+      window wall time;
+    - ``ps.handle_commit`` spans (worker + staleness attrs, from the
+      Python hub's handlers, ``commit_direct``, or the C++ hub's drained
+      commit log) -> per-worker staleness attribution and the
+      context-coverage ratio;
+    - ``ps.reconnect`` spans (worker attr) -> reconnect storms (a worker
+      with ``>= storm_threshold`` reconnects is flagged).
+
+    Returns a JSON-safe dict: ``workers`` (per-worker stats),
+    ``stragglers`` (worker ids, slowest first), ``top_straggler``,
+    ``commit_context_coverage`` and ``reconnect_storms``."""
+    spans = _span_records(events, trace_dir)
+
+    def bucket(worker: Any) -> Dict[str, Any]:
+        key = str(worker)
+        if key not in workers:
+            workers[key] = {"windows": 0, "window_ms_sum": 0.0,
+                            "window_ms_max": 0.0, "commits": 0,
+                            "staleness_sum": 0, "staleness_max": 0,
+                            "reconnects": 0}
+        return workers[key]
+
+    workers: Dict[str, Dict[str, Any]] = {}
+    commits_total = 0
+    commits_with_ctx = 0
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        name = s.get("name")
+        if name == "async.window" and "worker" in attrs:
+            b = bucket(attrs["worker"])
+            ms = s.get("dur_us", 0) / 1000.0
+            b["windows"] += 1
+            b["window_ms_sum"] += ms
+            b["window_ms_max"] = max(b["window_ms_max"], ms)
+        elif name == "ps.handle_commit":
+            commits_total += 1
+            worker = attrs.get("worker")
+            if worker is None or int(worker) < 0:
+                continue
+            commits_with_ctx += 1
+            b = bucket(worker)
+            b["commits"] += 1
+            stale = int(attrs.get("staleness", 0) or 0)
+            b["staleness_sum"] += stale
+            b["staleness_max"] = max(b["staleness_max"], stale)
+        elif name == "ps.reconnect" and "worker" in attrs:
+            bucket(attrs["worker"])["reconnects"] += 1
+
+    for b in workers.values():
+        b["mean_window_ms"] = round(b["window_ms_sum"] / b["windows"], 3) \
+            if b["windows"] else None
+        b["mean_staleness"] = round(b["staleness_sum"] / b["commits"], 3) \
+            if b["commits"] else None
+        b["window_ms_sum"] = round(b["window_ms_sum"], 3)
+        b["window_ms_max"] = round(b["window_ms_max"], 3)
+
+    ranked = sorted((w for w, b in workers.items()
+                     if b["mean_window_ms"] is not None),
+                    key=lambda w: workers[w]["mean_window_ms"], reverse=True)
+    storms = sorted(w for w, b in workers.items()
+                    if b["reconnects"] >= storm_threshold)
+    return {
+        "workers": workers,
+        "stragglers": ranked,
+        "top_straggler": ranked[0] if ranked else None,
+        "total_commits": commits_total,
+        "commit_context_coverage": (round(commits_with_ctx / commits_total, 4)
+                                    if commits_total else None),
+        "reconnect_storms": storms,
+    }

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 from bisect import bisect_left
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # Fixed log-scale histogram bounds: 3 buckets per decade from 1e-6 to
 # ~1e8 (microseconds-as-seconds through day-long waits; also spans byte
@@ -163,13 +163,39 @@ class Histogram:
         if not self._registry.enabled:
             return
         value = float(value)
+        if value != value:
+            # NaN: bisect against it is undefined ordering and it would
+            # poison sum/mean forever — drop the observation (a NaN
+            # latency is an upstream bug, not a data point)
+            return
         # bisect_left: a value equal to a bound belongs to that bound's
-        # bucket (Prometheus ``le`` is inclusive)
+        # bucket (Prometheus ``le`` is inclusive); anything past the last
+        # bound (incl. +inf) lands in the explicit overflow bucket, which
+        # renders as ``le="+Inf"``
         idx = bisect_left(DEFAULT_BUCKETS, value)
         with self._lock:
             self._counts[idx] += 1
             self._count += 1
             self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    def observe_n(self, value: float, n: int) -> None:
+        """Record ``n`` identical observations with ONE lock acquisition —
+        the bulk path for replaying an external histogram (the C++ hub's
+        staleness counts) without an O(n) observe loop."""
+        if not self._registry.enabled or n <= 0:
+            return
+        value = float(value)
+        if value != value:
+            return  # NaN: same contract as observe()
+        idx = bisect_left(DEFAULT_BUCKETS, value)
+        with self._lock:
+            self._counts[idx] += n
+            self._count += n
+            self._sum += value * n
             if self._min is None or value < self._min:
                 self._min = value
             if self._max is None or value > self._max:
@@ -294,43 +320,21 @@ class MetricsRegistry:
                 out["histograms"][key] = inst.summary()
         return out
 
+    def kind_of(self, name: str) -> Optional[str]:
+        """``"counter"``/``"gauge"``/``"histogram"`` for a registered
+        metric name (exposition renderers need the TYPE line)."""
+        return self._kinds.get(name)
+
     def render_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4, rendered on demand —
         the pull-style sink (no server here; the punchcard daemon's
         ``telemetry`` action and any embedding HTTP handler just return
-        this string).  Registry names may contain characters the
-        Prometheus grammar forbids (the client-side PS instruments are
-        dotted, e.g. ``ps.pull_latency_ms``); they are sanitized to
-        underscores HERE only — snapshots and the punchcard JSON keep the
-        registry spelling."""
-        by_name: Dict[str, List[object]] = {}
-        for inst in self.instruments():
-            by_name.setdefault(inst.name, []).append(inst)
-        lines: List[str] = []
-        for raw in sorted(by_name):
-            kind = self._kinds[raw]
-            name = _prometheus_name(raw)
-            lines.append(f"# TYPE {name} {kind}")
-            for inst in sorted(by_name[raw], key=lambda i: i.labels):
-                if isinstance(inst, Histogram):
-                    s = inst.summary()
-                    cum = 0
-                    dense: Dict[object, int] = dict(
-                        (le, c) for le, c in s["buckets"])
-                    for le in list(DEFAULT_BUCKETS) + ["+Inf"]:
-                        if le in dense:
-                            cum = dense[le]
-                        labels = dict(inst.labels)
-                        labels["le"] = "+Inf" if le == "+Inf" else f"{le:g}"
-                        key = _render_name(name + "_bucket", _label_key(labels))
-                        lines.append(f"{key} {cum}")
-                    lines.append(
-                        f"{_render_name(name + '_sum', inst.labels)} {s['sum']}")
-                    lines.append(
-                        f"{_render_name(name + '_count', inst.labels)} {s['count']}")
-                else:
-                    lines.append(f"{_render_name(name, inst.labels)} {inst.value}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        this string).  The renderer lives in :mod:`.sinks` (label-value
+        escaping and name sanitization are exposition-format concerns);
+        snapshots and the punchcard JSON keep the raw registry spelling."""
+        from distkeras_tpu.observability.sinks import render_prometheus
+
+        return render_prometheus(self)
 
     def reset(self) -> None:
         """Zero every instrument IN PLACE (tests; a fresh run's clean

@@ -7,9 +7,12 @@ Two pull/push shapes, both dependency-free:
   plus a ``"spans"`` list when a tracer is attached (spans are DRAINED —
   each is flushed exactly once).  Crash-safe by construction: every line
   is self-contained, so a truncated final line loses only itself.
-- :func:`render_prometheus` — the text exposition format, rendered on
-  demand (no HTTP server here; the punchcard daemon's ``telemetry``
-  action returns it, and any embedding web handler can too).
+- :func:`render_prometheus` — the text exposition format 0.0.4, rendered
+  on demand (no HTTP server here; the punchcard daemon's ``telemetry``
+  action returns it, and any embedding web handler can too).  Metric
+  names are sanitized onto the Prometheus grammar and label VALUES are
+  escaped per the text-format spec (backslash, double-quote, newline) —
+  an unescaped ``\\n`` or ``"`` in a label would corrupt the whole scrape.
 """
 
 from __future__ import annotations
@@ -17,14 +20,67 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
-from distkeras_tpu.observability.metrics import MetricsRegistry
+from distkeras_tpu.observability.metrics import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    _prometheus_name,
+)
 from distkeras_tpu.observability.tracing import SpanTracer
 
 
+def escape_label_value(value: str) -> str:
+    """Escape one label value per the Prometheus text exposition spec:
+    backslash -> ``\\\\``, double-quote -> ``\\"``, line feed -> ``\\n``
+    (backslash FIRST, or the other two would double-escape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _exposition_name(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
 def render_prometheus(registry: MetricsRegistry) -> str:
-    return registry.render_prometheus()
+    """Render ``registry`` in the text exposition format.  Histograms emit
+    the full cumulative ``_bucket`` series (every fixed log bound plus the
+    explicit ``le="+Inf"`` overflow) and ``_sum``/``_count``, so
+    ``histogram_quantile()`` works on every exported histogram (e.g.
+    ``ps_pull_latency_ms``)."""
+    by_name: Dict[str, List[object]] = {}
+    for inst in registry.instruments():
+        by_name.setdefault(inst.name, []).append(inst)
+    lines: List[str] = []
+    for raw in sorted(by_name):
+        kind = registry.kind_of(raw)
+        name = _prometheus_name(raw)
+        lines.append(f"# TYPE {name} {kind}")
+        for inst in sorted(by_name[raw], key=lambda i: i.labels):
+            if isinstance(inst, Histogram):
+                s = inst.summary()
+                cum = 0
+                dense: Dict[object, int] = dict(
+                    (le, c) for le, c in s["buckets"])
+                for le in list(DEFAULT_BUCKETS) + ["+Inf"]:
+                    if le in dense:
+                        cum = dense[le]
+                    labels = dict(inst.labels)
+                    labels["le"] = "+Inf" if le == "+Inf" else f"{le:g}"
+                    key = _exposition_name(
+                        name + "_bucket", tuple(sorted(labels.items())))
+                    lines.append(f"{key} {cum}")
+                lines.append(
+                    f"{_exposition_name(name + '_sum', inst.labels)} {s['sum']}")
+                lines.append(
+                    f"{_exposition_name(name + '_count', inst.labels)} {s['count']}")
+            else:
+                lines.append(f"{_exposition_name(name, inst.labels)} {inst.value}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class JsonlFlusher:

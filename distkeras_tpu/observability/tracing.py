@@ -79,7 +79,11 @@ class _Span:
         if stack and stack[-1] == self.name:
             stack.pop()
         if exc_type is not None:
-            self.attrs["error"] = exc_type.__name__
+            # a span that ends by raising is an ERROR span, not a silent
+            # close: error=1 makes failures countable/filterable in any
+            # trace viewer, error_type names the exception class
+            self.attrs["error"] = 1
+            self.attrs["error_type"] = exc_type.__name__
         self._tracer._record(self.name, self._t0, t1, self._depth, self.attrs)
 
 
@@ -113,13 +117,14 @@ class SpanTracer:
         return _Span(self, name, attrs)
 
     def _record(self, name: str, t0_ns: int, t1_ns: int, depth: int,
-                attrs: Dict[str, Any]) -> None:
+                attrs: Dict[str, Any], tid: Optional[Any] = None) -> None:
         event = {
             "name": name,
-            "ts_us": t0_ns // 1000,          # perf_counter epoch, process-local
-            "dur_us": max((t1_ns - t0_ns) // 1000, 0),
-            "tid": threading.get_ident(),
-            "thread": threading.current_thread().name,
+            "ts_us": int(t0_ns) // 1000,     # perf_counter epoch, process-local
+            "dur_us": max((int(t1_ns) - int(t0_ns)) // 1000, 0),
+            "tid": threading.get_ident() if tid is None else tid,
+            "thread": (threading.current_thread().name if tid is None
+                       else str(tid)),
             "depth": depth,
         }
         if attrs:
@@ -128,6 +133,17 @@ class SpanTracer:
             if len(self._events) == self.capacity:
                 self.dropped += 1
             self._events.append(event)
+
+    def record_span(self, name: str, t0_ns: int, t1_ns: int,
+                    tid: Optional[Any] = None, **attrs: Any) -> None:
+        """Record a span with EXPLICIT timestamps (same monotonic epoch as
+        ``time.perf_counter_ns``) — for spans measured outside Python,
+        e.g. the C++ hub's commit log replayed by
+        ``NativeParameterServer.sync_telemetry``.  ``tid`` overrides the
+        track (default: the calling thread)."""
+        if not self.enabled:
+            return
+        self._record(name, t0_ns, t1_ns, 0, attrs, tid=tid)
 
     # -- introspection / export ------------------------------------------------
     def __len__(self) -> int:

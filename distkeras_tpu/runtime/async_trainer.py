@@ -44,6 +44,7 @@ finish.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -53,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import observability as obs
+from distkeras_tpu.observability import distributed as dtrace
 from distkeras_tpu.data.dataset import Dataset, prefetch_to_device
 from distkeras_tpu.models.base import Model
 from distkeras_tpu.parallel.engine import make_minibatch_step
@@ -115,6 +117,7 @@ class AsyncDistributedTrainer(Trainer):
                  heartbeat_interval: Optional[float] = None,
                  elastic: bool = False,
                  ps_idle_timeout: Optional[float] = None,
+                 trace_context: Optional[str] = None,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -198,6 +201,14 @@ class AsyncDistributedTrainer(Trainer):
         # it.  Standalone hubs (distkeras-ps / start_parameter_server)
         # default to 300 s — they face real networks
         self.ps_idle_timeout = ps_idle_timeout
+        # distributed tracing (ISSUE #5): the job id every worker's
+        # TraceContext announces over the PS wire.  None = auto-generate a
+        # fresh one per train() when telemetry is on; pass an explicit id
+        # to join a multi-host run's workers under one job in the merged
+        # trace (all hosts must pass the same string).  Only consulted
+        # while telemetry is enabled — with obs off no context exists and
+        # no T frame ever leaves (pre-T hubs interoperate)
+        self.trace_context = trace_context
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
@@ -318,6 +329,21 @@ class AsyncDistributedTrainer(Trainer):
             ps.start()
             ps_host, ps_port = "127.0.0.1", ps.port
         self.parameter_server = ps
+        # distributed tracing: one job id for every worker this run spawns
+        # (explicit trace_context joins multi-host workers under one job).
+        # Resolved once here so a restarted worker keeps the job identity.
+        # The process clock-sync estimate resets per run: an offset
+        # measured against a PREVIOUS run's hub must not outlive it
+        trace_job = ((self.trace_context or dtrace.new_job_id())
+                     if obs.enabled() else None)
+        if trace_job is not None:
+            dtrace.reset_clock_sync()
+            if os.environ.get("DKT_TRACE_DIR"):
+                # this run flushes its ring at the end under THIS job id:
+                # spans surviving from a previous train() in the same
+                # process must not be re-flushed (and double-counted by
+                # merge_traces/fleet_report) under the new job
+                obs.TRACER.clear()
 
         # note: chunk_windows is moot here — the async worker loop already
         # feeds one window per device transfer (stacked_epoch slices are
@@ -361,16 +387,27 @@ class AsyncDistributedTrainer(Trainer):
             attempt's partial-epoch losses before the replay re-records
             them (history must not double-count replayed windows)."""
             device = devices[idx % len(devices)]
+            # per-worker trace context: announced over the PS wire (socket)
+            # or read thread-locally by the hub's direct path (inproc), so
+            # hub-side spans are attributable to THIS worker.  A restarted
+            # worker gets a fresh span_id under the same job/worker ids
+            ctx = None
+            if trace_job is not None:
+                ctx = dtrace.TraceContext(job_id=trace_job, worker_id=idx,
+                                          span_id=dtrace.new_span_id())
+                dtrace.activate(ctx)
             if self.transport == "inproc":
                 client = InprocPSClient(ps, templates=flat0,
-                                        compress=self.compress_commits)
+                                        compress=self.compress_commits,
+                                        trace_context=ctx)
             else:
                 client = PSClient(ps_host, ps_port, templates=flat0,
                                   compress=self.compress_commits,
                                   max_inflight=self.max_inflight_commits,
                                   max_reconnects=self.max_reconnects,
                                   reconnect_backoff=self.reconnect_backoff,
-                                  heartbeat_interval=self.heartbeat_interval)
+                                  heartbeat_interval=self.heartbeat_interval,
+                                  trace_context=ctx)
             pipeline = self.pipeline
             try:
                 shard = dataset.shard(self.num_workers, idx)
@@ -585,6 +622,20 @@ class AsyncDistributedTrainer(Trainer):
             samples=total_windows * self.communication_window * self.batch_size,
             seconds=self.get_training_time(),
             chips=min(self.num_workers, len(devices)))
+        # fleet-wide merge hook: when DKT_TRACE_DIR is set (and telemetry
+        # on), flush this process's span ring — in worker-only mode every
+        # worker host writes its own file with its PS-round-trip clock
+        # offset, and merge_traces(dir) aligns them all on the hub timeline
+        trace_dir = os.environ.get("DKT_TRACE_DIR")
+        if trace_dir and obs.enabled():
+            try:
+                dtrace.flush_process_trace(
+                    trace_dir, job_id=trace_job,
+                    role="trainer" if ps is not None else "worker")
+            except OSError as e:
+                import warnings
+
+                warnings.warn(f"trace flush to {trace_dir} failed: {e}")
         self.model = Model(spec=self.model.spec,
                            params=jax.tree.unflatten(treedef, [jnp.asarray(w) for w in final]))
         self.record_training_end()

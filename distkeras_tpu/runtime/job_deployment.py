@@ -443,6 +443,14 @@ class Punchcard:
                 resp["prometheus"] = obs.render_prometheus()
             if req.get("trace"):
                 resp["trace"] = obs.chrome_trace()
+            if req.get("fleet"):
+                # straggler/staleness attribution over this process's span
+                # ring (ISSUE #5) — when a trace directory is configured
+                # the report instead joins EVERY flushed process's spans
+                from distkeras_tpu.observability.distributed import fleet_report
+
+                resp["fleet"] = fleet_report(
+                    trace_dir=os.environ.get("DKT_TRACE_DIR") or None)
             net.send_json(conn, resp)
         elif action == "shutdown":
             net.send_json(conn, {"ok": True})
@@ -828,10 +836,11 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._request({"action": "status", "job_id": self.job_id})
 
-    def telemetry(self, trace: bool = False) -> Dict[str, Any]:
+    def telemetry(self, trace: bool = False, fleet: bool = False) -> Dict[str, Any]:
         """The daemon's live telemetry snapshot (see :func:`fetch_telemetry`);
         daemon-wide, so it does not require this job to be submitted."""
-        return fetch_telemetry(self.host, self.port, self.secret, trace=trace)
+        return fetch_telemetry(self.host, self.port, self.secret, trace=trace,
+                               fleet=fleet)
 
     def cancel(self) -> str:
         if self.job_id is None:
@@ -882,15 +891,20 @@ def list_jobs(host: str, port: int, secret: str) -> List[Dict[str, Any]]:
 
 def fetch_telemetry(host: str, port: int, secret: str,
                     trace: bool = False,
-                    prometheus: bool = False) -> Dict[str, Any]:
+                    prometheus: bool = False,
+                    fleet: bool = False) -> Dict[str, Any]:
     """Pull the daemon process's telemetry (authenticated): the metrics
     snapshot, plus the span ring as Chrome ``trace_event`` JSON when
-    ``trace=True`` and the Prometheus text exposition when
-    ``prometheus=True``.  Works mid-job — this is how a running job's
-    counters/staleness/window histograms are read remotely."""
+    ``trace=True``, the Prometheus text exposition when
+    ``prometheus=True``, and the distributed-tracing
+    :func:`~distkeras_tpu.observability.distributed.fleet_report`
+    (straggler ranking, per-worker staleness attribution, reconnect
+    storms) when ``fleet=True``.  Works mid-job — this is how a running
+    job's counters/staleness/window histograms are read remotely."""
     with _Conn(host, port, secret) as conn:
         return conn.request({"action": "telemetry", "trace": bool(trace),
-                             "prometheus": bool(prometheus)})
+                             "prometheus": bool(prometheus),
+                             "fleet": bool(fleet)})
 
 
 def shutdown(host: str, port: int, secret: str) -> None:

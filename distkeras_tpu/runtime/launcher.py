@@ -199,6 +199,25 @@ def main(argv: Optional[List[str]] = None) -> None:
         pass
     finally:
         ps.stop()
+        # distributed tracing: the hub process is the merge's clock
+        # REFERENCE (offset 0) — flush its spans (handler-side
+        # ps.handle_commit/pull, snapshot, eviction; the C++ hub's drained
+        # commit log lands here through stop()'s sync_telemetry) so
+        # merge_traces(DKT_TRACE_DIR) can align every worker against it.
+        # DKT_TELEMETRY=1 DKT_TRACE_DIR=... is the whole recipe
+        trace_dir = os.environ.get("DKT_TRACE_DIR")
+        if trace_dir:
+            from distkeras_tpu import observability as obs
+
+            if obs.enabled():
+                from distkeras_tpu.observability.distributed import (
+                    flush_process_trace,
+                )
+
+                try:
+                    flush_process_trace(trace_dir, role="hub")
+                except OSError as e:
+                    print(f"trace flush failed: {e}", flush=True)
         if args.save_final:
             from distkeras_tpu.utils import flatten_weights, unflatten_weights
 

@@ -19,9 +19,12 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+from distkeras_tpu import observability as obs
+from distkeras_tpu.observability import distributed as dtrace
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native", "ps_server.cpp")
@@ -113,8 +116,22 @@ def _bind_ps(lib: ctypes.CDLL) -> None:
     lib.dk_ps_port.argtypes = [ctypes.c_void_p]
     lib.dk_ps_pull.restype = ctypes.c_int64
     lib.dk_ps_pull.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+    lib.dk_ps_snapshot.restype = ctypes.c_int64
+    lib.dk_ps_snapshot.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
     lib.dk_ps_commit.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
                                  ctypes.c_int64]
+    lib.dk_ps_commit_ctx.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_int64, ctypes.c_int64]
+    lib.dk_ps_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.dk_ps_staleness_hist.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_int64)]
+    lib.dk_ps_drain_commits.restype = ctypes.c_int64
+    lib.dk_ps_drain_commits.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.c_int64]
+    lib.dk_ps_time_ns.restype = ctypes.c_int64
+    lib.dk_ps_time_ns.argtypes = [ctypes.c_void_p]
     lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
 
 
@@ -170,6 +187,12 @@ class NativeParameterServer:
         lib.dk_ps_set_weights(self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         self.port = -1
         self._started = False
+        # telemetry bridge state: last-seen cumulative stats/histogram so
+        # sync_telemetry() can inc() registry counters by DELTAS only
+        self._stats_lock = threading.Lock()
+        self._last_stats = [0] * 9
+        self._last_stale_hist = [0] * 65
+        self._drain_buf = np.zeros(4096 * 5, np.int64)
         self._restore = bool(restore)
         self.snapshotter = None
         if restore and snapshot_dir is None:
@@ -216,16 +239,116 @@ class NativeParameterServer:
         if self._started:
             if self.snapshotter is not None:
                 self.snapshotter.stop(final_snapshot=final_snapshot)
+            # surface the C++ hub's final counters/commit log into the
+            # registry/tracer before the serving threads go away
+            try:
+                self.sync_telemetry()
+            except Exception:
+                pass  # telemetry must never block a teardown
             self._lib.dk_ps_stop(self._handle)
             self._started = False
+
+    # -- telemetry bridge (dk_ps_stats and friends) ----------------------------
+    _STAT_KEYS = ("commits", "pulls", "commit_bytes", "pull_bytes",
+                  "fenced_commits", "live_workers", "idle_evictions", "clock",
+                  "commit_log_dropped")
+
+    def stats(self) -> Dict[str, int]:
+        """The C++ hub's cumulative counters, by name (see ``dk_ps_stats``
+        in ``native/ps_server.cpp``)."""
+        out = (ctypes.c_int64 * 9)()
+        self._lib.dk_ps_stats(self._handle, out)
+        return dict(zip(self._STAT_KEYS, [int(v) for v in out]))
+
+    def time_ns(self) -> int:
+        """The hub's CLOCK_MONOTONIC in ns — the same epoch Python's
+        ``time.perf_counter_ns`` reads on Linux (offset sanity checks)."""
+        return int(self._lib.dk_ps_time_ns(self._handle))
+
+    def sync_telemetry(self) -> None:
+        """Drain the C++ hub's telemetry into the process registry/tracer
+        under the SAME names the Python hub emits (``ps_commits_total``,
+        ``ps_commit_staleness``, ...), so Prometheus/punchcard output is
+        hub-implementation-agnostic.  Counters advance by deltas against
+        the last sync; the commit log becomes ``ps.handle_commit`` spans
+        (worker attribution from the wire ``T`` announce or
+        ``commit_direct``'s caller context).  Called automatically at
+        shutdown and on every hub snapshot; call it directly for an
+        up-to-the-moment mid-run view."""
+        if not obs.enabled():
+            return
+        with self._stats_lock:
+            stats = self.stats()
+            vals = [stats[k] for k in self._STAT_KEYS]
+            delta = {k: v - last for k, v, last
+                     in zip(self._STAT_KEYS, vals, self._last_stats)}
+            self._last_stats = vals
+            for key, name in (("commits", "ps_commits_total"),
+                              ("pulls", "ps_pulls_total"),
+                              ("commit_bytes", "ps_commit_bytes_total"),
+                              ("pull_bytes", "ps_pull_bytes_total"),
+                              ("fenced_commits", "ps_fenced_commits_total"),
+                              ("idle_evictions", "ps_idle_evictions_total"),
+                              # commit-log ring wraps between drains lose
+                              # per-commit spans; the loss must be VISIBLE
+                              # (same contract as SpanTracer.dropped)
+                              ("commit_log_dropped",
+                               "ps_commit_log_dropped_total")):
+                if delta[key] > 0:
+                    obs.counter(name).inc(delta[key])
+            obs.gauge("ps_live_workers").set(stats["live_workers"])
+            # exact small-integer staleness counts -> the shared log-bucket
+            # histogram (value == slot; the overflow slot observes as its
+            # lower bound, a documented approximation)
+            hist = (ctypes.c_int64 * 65)()
+            self._lib.dk_ps_staleness_hist(self._handle, hist)
+            stale = obs.histogram("ps_commit_staleness")
+            for slot in range(65):
+                # bulk replay: O(65) per sync regardless of commit count
+                stale.observe_n(slot, int(hist[slot]) - self._last_stale_hist[slot])
+                self._last_stale_hist[slot] = int(hist[slot])
+            # commit log -> hub-side spans on a dedicated "native-hub"
+            # track (timestamps are CLOCK_MONOTONIC ns — the tracer's own
+            # epoch, so no conversion)
+            while True:
+                n = int(self._lib.dk_ps_drain_commits(
+                    self._handle,
+                    self._drain_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    4096))
+                for i in range(n):
+                    clock, worker, staleness, t_ns, dur_ns = \
+                        (int(v) for v in self._drain_buf[i * 5:i * 5 + 5])
+                    attrs = {"staleness": staleness, "clock": clock,
+                             "hub": "native"}
+                    if worker >= 0:
+                        attrs["worker"] = worker
+                    obs.TRACER.record_span("ps.handle_commit", t_ns,
+                                           t_ns + dur_ns, tid="native-hub",
+                                           **attrs)
+                if n < 4096:
+                    break
 
     # -- durability (HubSnapshotter surface) -----------------------------------
     def snapshot_state(self):
         """(center tensors, JSON-typed state dict) — one atomic view via the
-        C++ pull path (center + clock under the hub mutex)."""
-        center, clock = self.pull_direct()
-        return ([c.copy() for c in center],
-                {"clock": int(clock), "num_updates": int(self.num_updates)})
+        C++ snapshot path (center + clock under the hub mutex; NOT counted
+        as a pull — the Python hub's snapshot_state is uncounted too).
+        Piggybacks a telemetry sync: a snapshotting hub surfaces its C++
+        counters into the registry at least once per snapshot interval, so
+        mid-run punchcard pulls see fresh native-hub numbers."""
+        try:
+            self.sync_telemetry()
+        except Exception:
+            pass
+        flat = np.empty(self._total, np.float32)
+        clock = int(self._lib.dk_ps_snapshot(
+            self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+        center, off = [], 0
+        for t in self._templates:
+            center.append(flat[off:off + t.size].reshape(t.shape).copy())
+            off += t.size
+        return (center,
+                {"clock": clock, "num_updates": int(self.num_updates)})
 
     def restore_state(self, center: Sequence[np.ndarray], state) -> None:
         if len(center) != len(self._templates):
@@ -279,9 +402,14 @@ class NativeParameterServer:
                                  f"size {t.size}")
             parts.append(a)
         flat = np.concatenate(parts) if parts else np.zeros(0, np.float32)
-        self._lib.dk_ps_commit(
+        # attribute the commit to the calling worker thread's trace
+        # context (inproc workers have no connection to announce T on);
+        # -1 = uncontexted, matching the wire default
+        ctx = dtrace.current()
+        worker = int(ctx.worker_id) if ctx is not None else -1
+        self._lib.dk_ps_commit_ctx(
             self._handle, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            int(last_pull_clock))
+            int(last_pull_clock), worker)
 
     @property
     def num_updates(self) -> int:

@@ -71,6 +71,14 @@ ACTION_BYE = b"B"
 ACTION_WEIGHTS = b"W"
 ACTION_ACK = b"A"
 ACTION_PING = b"H"  # client heartbeat-on-idle; hub replies with an ack
+# trace-context announce: one JSON blob (job_id/worker_id/span_id); the hub
+# remembers the context for this connection's spans and replies with an
+# action-T frame carrying one 8-byte big-endian blob = the hub's monotonic
+# clock in ns (the NTP-style sample the client's offset estimate is built
+# from).  Sent only when distributed tracing is configured, so pre-T hubs
+# never see it (the PR 3/4 convention: wire bytes of every pre-existing
+# frame are unchanged, new frames are opt-in).
+ACTION_TRACE = b"T"
 
 
 class ProtocolError(ValueError):
@@ -331,6 +339,34 @@ def recv_action(sock: socket.socket) -> bytes:
         obs.counter("net_rx_frames_total").inc()
         obs.counter("net_rx_bytes_total").inc(8 + n)
     return payload[0:1]
+
+
+# -- trace-context announce (action T) ----------------------------------------
+
+def encode_context_payload(context_json: bytes) -> bytes:
+    """The client->hub trace-context announce payload: an action-``T``
+    tensor frame whose single blob is the UTF-8 JSON encoding of the
+    announcing worker's :class:`~distkeras_tpu.observability.distributed.
+    TraceContext`."""
+    return encode_tensors(ACTION_TRACE, [np.frombuffer(context_json, np.uint8)])
+
+
+def encode_time_payload(t_ns: int) -> bytes:
+    """The hub->client ``T`` reply payload: one 8-byte big-endian blob
+    carrying the hub's monotonic clock in nanoseconds."""
+    return ACTION_TRACE + struct.pack(">I", 1) + struct.pack(">Q", 8) \
+        + struct.pack(">Q", t_ns)
+
+
+def decode_time_payload(blobs: Sequence) -> int:
+    """Inverse of :func:`encode_time_payload` given the decoded blob list."""
+    if not blobs:
+        raise ProtocolError("T reply carries no timestamp blob")
+    raw = bytes(memoryview(blobs[0]))[:8]
+    if len(raw) != 8:
+        raise ProtocolError(f"T timestamp blob has {len(raw)} bytes, want 8")
+    (t_ns,) = struct.unpack(">Q", raw)
+    return t_ns
 
 
 def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:

@@ -45,6 +45,7 @@ import time
 import numpy as np
 
 from distkeras_tpu import observability as obs
+from distkeras_tpu.observability import distributed as dtrace
 from distkeras_tpu.runtime import networking as net
 
 
@@ -98,7 +99,7 @@ class HubSnapshotter:
         return False
 
     def save_now(self) -> None:
-        with self._save_lock:
+        with self._save_lock, obs.span("ps.snapshot"):
             t0 = time.perf_counter()
             center, state = self.hub.snapshot_state()
             self.checkpointer.save(
@@ -429,6 +430,10 @@ class SocketParameterServer:
             self._member_seq += 1
             member_token = self._member_seq
         joined = False
+        # trace context announced via action T (None until the worker
+        # announces): every span this handler records is tagged with it,
+        # so hub-side work is attributable to the worker that caused it
+        ctx_attrs: Dict[str, Any] = {}
         # per-connection reusable storage: the receive buffer grows once to
         # the largest frame this worker sends (a commit), the reply codec
         # holds one prepacked weights frame, the ack is a 13-byte constant
@@ -456,6 +461,8 @@ class SocketParameterServer:
                     # handler thread and a membership slot forever
                     if obs.enabled():
                         obs.counter("ps_idle_evictions_total").inc()
+                        with obs.span("ps.evict", conn=conn_idx, **ctx_attrs):
+                            pass
                     break
                 action, blobs = net.decode_tensor_views(payload)
                 if joined:
@@ -463,13 +470,15 @@ class SocketParameterServer:
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
-                    with self._lock:
-                        # pack the center STRAIGHT into the reply frame (one
-                        # memcpy per tensor) under the lock; the send happens
-                        # after release so a slow peer can't hold the center
-                        reply.pack(net.ACTION_WEIGHTS, self.center)
-                        last_pull_clock = self._clock
-                    reply.send_packed(conn)
+                    with obs.span("ps.handle_pull", conn=conn_idx, **ctx_attrs):
+                        with self._lock:
+                            # pack the center STRAIGHT into the reply frame
+                            # (one memcpy per tensor) under the lock; the
+                            # send happens after release so a slow peer
+                            # can't hold the center
+                            reply.pack(net.ACTION_WEIGHTS, self.center)
+                            last_pull_clock = self._clock
+                        reply.send_packed(conn)
                     if telemetry:
                         obs.counter("ps_pulls_total").inc()
                         obs.counter("ps_pull_bytes_total").inc(self._center_bytes)
@@ -485,12 +494,19 @@ class SocketParameterServer:
                         # elastic denominators
                         joined = True
                         self._member_join(member_token)
-                    with self._lock:
-                        staleness = self._clock - last_pull_clock
-                        self.apply_commit(delta, staleness)
-                        self.num_updates += 1
-                        self._clock += 1
-                    net.send_raw_frame(conn, ack)
+                    with obs.span("ps.handle_commit", conn=conn_idx,
+                                  **ctx_attrs) as sp:
+                        with self._lock:
+                            staleness = self._clock - last_pull_clock
+                            self.apply_commit(delta, staleness)
+                            self.num_updates += 1
+                            self._clock += 1
+                        net.send_raw_frame(conn, ack)
+                        if getattr(sp, "attrs", None) is not None:
+                            # the span's attribution payload: the staleness
+                            # this exact commit applied with (fleet_report
+                            # joins it to the announcing worker)
+                            sp.attrs["staleness"] = staleness
                     if telemetry:
                         obs.counter("ps_commits_total").inc()
                         obs.counter("ps_commit_bytes_total").inc(
@@ -505,6 +521,23 @@ class SocketParameterServer:
                         obs.gauge("ps_staleness",
                                   conn=str(conn_idx)).set(staleness)
                         obs.histogram("ps_commit_staleness").observe(staleness)
+                elif action == net.ACTION_TRACE:
+                    # trace-context announce: tag this connection's spans
+                    # with the worker's identity and reply with this hub's
+                    # monotonic clock (the NTP-style sample the client's
+                    # offset estimate is built from).  Malformed context is
+                    # ignored, not fatal — tracing must never take down a
+                    # training connection
+                    try:
+                        ctx = dtrace.TraceContext.from_json(bytes(blobs[0]))
+                        ctx_attrs = ctx.span_attrs()
+                    except Exception:
+                        # any malformed blob shape (missing blob, non-object
+                        # JSON, null fields -> TypeError/AttributeError):
+                        # an unattributed connection, never a dropped one
+                        ctx_attrs = {}
+                    net.send_frame(conn, net.encode_time_payload(
+                        time.perf_counter_ns()))
                 elif action == net.ACTION_PING:
                     # heartbeat-on-idle: proves liveness (resetting the
                     # idle clock above) and keeps a slow-but-alive worker's
@@ -543,9 +576,13 @@ class SocketParameterServer:
         connection state does."""
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
-        with self._lock:
-            snapshot = [w.copy() for w in self.center]
-            clock = self._clock
+        # the inproc call runs IN the worker's thread, so the committing
+        # worker's thread-local trace context IS the right attribution
+        with obs.span("ps.handle_pull", transport="inproc",
+                      **dtrace.current_span_attrs()):
+            with self._lock:
+                snapshot = [w.copy() for w in self.center]
+                clock = self._clock
         if telemetry:
             obs.counter("ps_pulls_total").inc()
             obs.histogram("ps_rpc_seconds", rpc="pull.inproc").observe(
@@ -567,18 +604,22 @@ class SocketParameterServer:
         # trainers' float32 payloads)
         arrays = [np.asarray(d, np.float32).reshape(c.shape)
                   for d, c in zip(delta, self.center)]
-        with self._lock:
-            if last_pull_clock < self._clock_fence:
-                # pre-restart pull clock: fence it at the restore point —
-                # the commit applies with restart-relative staleness
-                # instead of a clock from a dead incarnation
-                last_pull_clock = self._clock_fence
-                if telemetry:
-                    obs.counter("ps_fenced_commits_total").inc()
-            staleness = self._clock - last_pull_clock
-            self.apply_commit(arrays, staleness)
-            self.num_updates += 1
-            self._clock += 1
+        with obs.span("ps.handle_commit", transport="inproc",
+                      **dtrace.current_span_attrs()) as sp:
+            with self._lock:
+                if last_pull_clock < self._clock_fence:
+                    # pre-restart pull clock: fence it at the restore point —
+                    # the commit applies with restart-relative staleness
+                    # instead of a clock from a dead incarnation
+                    last_pull_clock = self._clock_fence
+                    if telemetry:
+                        obs.counter("ps_fenced_commits_total").inc()
+                staleness = self._clock - last_pull_clock
+                self.apply_commit(arrays, staleness)
+                self.num_updates += 1
+                self._clock += 1
+            if getattr(sp, "attrs", None) is not None:
+                sp.attrs["staleness"] = staleness
         if telemetry:
             obs.counter("ps_commits_total").inc()
             obs.histogram("ps_rpc_seconds", rpc="commit.inproc").observe(
@@ -744,7 +785,8 @@ class PSClient:
                  max_reconnects: int = 0,
                  reconnect_backoff: float = 0.1,
                  reconnect_backoff_max: float = 5.0,
-                 heartbeat_interval: Optional[float] = None):
+                 heartbeat_interval: Optional[float] = None,
+                 trace_context: Optional["dtrace.TraceContext"] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -790,15 +832,66 @@ class PSClient:
         self._last_io = time.monotonic()
         self.sock = net.connect(host, port, timeout=timeout,
                                 payload_hint=self._codec.frame_len)
+        # distributed tracing (ISSUE #5): this worker's trace context,
+        # announced over the wire (action T) so the hub's spans are
+        # attributable, with the local->hub clock offset estimated from
+        # the announce round trips (NTP-style midpoint).  Off (None) by
+        # default: an un-announced client sends exactly the pre-T byte
+        # stream, so it interoperates with pre-T hubs
+        self.trace_context = trace_context
+        self.clock_offset_ns = 0
+        self.clock_error_ns: Optional[int] = None
         self.heartbeat_interval = (None if heartbeat_interval is None
                                    else float(heartbeat_interval))
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._ping_frame = net.empty_tensor_frame(net.ACTION_PING)
+        # announce AFTER every attribute exists (a failed announce —
+        # e.g. tracing enabled against a pre-T hub — must leave an object
+        # whose close() works) and BEFORE the heartbeat thread starts
+        # (the announce round trips own the socket exclusively)
+        if trace_context is not None:
+            try:
+                self._announce_and_sync()
+            except BaseException:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise
         if self.heartbeat_interval is not None:
             self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                                daemon=True)
             self._hb_thread.start()
+
+    # -- distributed tracing ---------------------------------------------------
+    def _announce_and_sync(self, rounds: int = 3) -> None:
+        """Send the action-T context announce and estimate the local->hub
+        clock offset from its round trips: the hub stamps its monotonic
+        clock into each reply, ``offset = hub_ts - (t0 + t1) / 2``, and
+        the minimum-RTT sample wins (its error bound, rtt/2, is the
+        alignment-error contract ``merge_traces`` documents).  Runs on the
+        freshly-connected socket BEFORE any pipelined traffic, so the
+        strict reply FIFO is never disturbed."""
+        announce = net.encode_context_payload(
+            self.trace_context.to_json().encode("utf-8"))
+        best_rtt = best_offset = None
+        for _ in range(max(1, rounds)):
+            t0 = time.perf_counter_ns()
+            net.send_frame(self.sock, announce)
+            action, blobs = net.recv_tensors(self.sock)
+            t1 = time.perf_counter_ns()
+            if action != net.ACTION_TRACE:
+                raise net.ProtocolError(
+                    f"expected T reply to context announce, got {action!r}")
+            hub_ns = net.decode_time_payload(blobs)
+            rtt = t1 - t0
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt = rtt
+                best_offset = hub_ns - (t0 + t1) // 2
+        self.clock_offset_ns = int(best_offset)
+        self.clock_error_ns = int(best_rtt) // 2
+        dtrace.record_clock_sync(self.clock_offset_ns, self.clock_error_ns)
 
     # -- resilience ------------------------------------------------------------
     _RETRYABLE = (ConnectionError, OSError, net.ProtocolError)
@@ -863,6 +956,7 @@ class PSClient:
         ``ConnectionError`` from ``cause`` once the lifetime budget is
         exhausted."""
         t_fault = time.perf_counter()
+        t_fault_ns = time.perf_counter_ns()
         # the ENTIRE teardown/backoff/redial runs under the io lock: the
         # heartbeat thread must neither ping a socket mid-replacement nor
         # close (its failure path) the freshly reconnected one — and with
@@ -892,6 +986,11 @@ class PSClient:
                     self.sock = net.connect(self.host, self.port,
                                             timeout=self.timeout,
                                             payload_hint=self._codec.frame_len)
+                    # re-announce the trace context on the fresh
+                    # connection (a restarted hub has no memory of the
+                    # old one) and refresh the clock-offset estimate
+                    if self.trace_context is not None:
+                        self._announce_and_sync()
                     # re-pull cleanly INSIDE the attempt: the discarded
                     # in-flight pulls are re-issued so wait_weights finds
                     # its reply.  A hub dying again right here must consume
@@ -904,16 +1003,22 @@ class PSClient:
                                               time.perf_counter()))
                     self._last_io = time.monotonic()
                     break
-                except OSError:
-                    # hub still down (or died again mid-re-pull): drop any
-                    # entries from the half-reconnected socket and back
-                    # off further on the next attempt
+                except (OSError, net.ProtocolError):
+                    # hub still down (or died again mid-re-pull/announce):
+                    # drop any entries from the half-reconnected socket
+                    # and back off further on the next attempt
                     self._pending.clear()
                     continue
         if obs.enabled():
+            # labelled by announced worker identity when tracing is on, so
+            # fleet_report can attribute reconnect storms to a worker
+            wattrs = (self.trace_context.span_attrs()
+                      if self.trace_context is not None else {})
             obs.counter("ps.reconnects").inc()
             obs.histogram("ps.reconnect_ms").observe(
                 (time.perf_counter() - t_fault) * 1e3)
+            obs.TRACER.record_span("ps.reconnect", t_fault_ns,
+                                   time.perf_counter_ns(), **wattrs)
 
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
@@ -1123,7 +1228,8 @@ class InprocPSClient:
     compressed runs also stay trajectory-identical across transports."""
 
     def __init__(self, ps: Any, templates: Sequence[np.ndarray],
-                 compress: Optional[str] = None):
+                 compress: Optional[str] = None,
+                 trace_context: Optional["dtrace.TraceContext"] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.ps = ps
@@ -1133,6 +1239,15 @@ class InprocPSClient:
                           if compress else None)
         self._last_pull_clock = 0
         self._pulled: Optional[List[np.ndarray]] = None
+        # inproc shares the hub's process AND clock: the context needs no
+        # wire announce (the hub reads the worker thread's context via
+        # dtrace.current()), and the clock offset is exactly zero — which
+        # is ALSO the process default when nothing ever syncs, so nothing
+        # is recorded globally (an unbeatable error=0 record would pin a
+        # later socket job in this process to a stale zero offset)
+        self.trace_context = trace_context
+        self.clock_offset_ns = 0
+        self.clock_error_ns: Optional[int] = 0 if trace_context is not None else None
 
     # -- pipelined API (eager) -------------------------------------------------
     def pull_nowait(self) -> None:

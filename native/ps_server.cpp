@@ -18,7 +18,22 @@
 //            'Q' int8 commit (per tensor: be f32 scale + int8 values,
 //                dequantized here, then the same scaling rules) -> 'A'
 //            'H' heartbeat (liveness proof while idle) -> 'A'
+//            'T' trace-context announce (one JSON blob: job_id/worker_id/
+//                span_id) -> 'T' + one 8-byte blob = this hub's
+//                CLOCK_MONOTONIC nanoseconds (the NTP-style midpoint
+//                sample the client's clock-offset estimate is built from;
+//                Python's time.perf_counter_ns() reads the same clock on
+//                Linux, so offsets are directly meaningful)
 //            'B' bye -> connection closes
+//
+// Telemetry (dk_ps_stats / dk_ps_staleness_hist / dk_ps_drain_commits):
+// the hub counts commits/pulls/payload bytes/fenced commits/idle
+// evictions, keeps an exact small-integer staleness histogram, and logs
+// every applied commit (clock, announcing worker, staleness, monotonic
+// timestamp, apply duration) into a bounded ring.  The Python wrapper
+// (runtime/native.py :: sync_telemetry) drains these into the SAME
+// registry names the Python hub emits, so Prometheus/punchcard output is
+// hub-implementation-agnostic.
 //
 // Commit scaling modes (matching runtime/parameter_server.py):
 //   0 delta:  center += d                (DOWNPOUR, elastic)
@@ -31,15 +46,26 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cctype>
+#include <cerrno>
+#include <ctime>
+
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <thread>
 #include <vector>
 
 namespace {
+
+int64_t mono_ns() {
+  timespec ts{};
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000 + int64_t(ts.tv_nsec);
+}
 
 uint64_t be64_decode(const unsigned char* b) {
   uint64_t v = 0;
@@ -59,15 +85,39 @@ void be32_encode(uint32_t v, unsigned char* b) {
   b[0] = v >> 24; b[1] = (v >> 16) & 0xff; b[2] = (v >> 8) & 0xff; b[3] = v & 0xff;
 }
 
-bool read_exact(int fd, void* buf, size_t n) {
+bool read_exact(int fd, void* buf, size_t n, bool* timed_out = nullptr) {
   auto* p = static_cast<unsigned char*>(buf);
   size_t got = 0;
   while (got < n) {
     ssize_t r = ::recv(fd, p + got, n - got, 0);
-    if (r <= 0) return false;
+    if (r <= 0) {
+      // distinguish SO_RCVTIMEO expiry (idle eviction) from EOF/reset so
+      // the eviction counter matches the Python hub's semantics
+      if (timed_out && r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+        *timed_out = true;
+      return false;
+    }
     got += size_t(r);
   }
   return true;
+}
+
+// minimal extraction of an integer JSON field (the 'T' announce blob is
+// produced by our own client, so a full parser buys nothing): returns
+// fallback when the key is absent/malformed
+int64_t json_int_field(const unsigned char* buf, size_t n, const char* key,
+                       int64_t fallback) {
+  std::string s(reinterpret_cast<const char*>(buf), n);
+  std::string needle = std::string("\"") + key + "\"";
+  size_t pos = s.find(needle);
+  if (pos == std::string::npos) return fallback;
+  pos = s.find(':', pos + needle.size());
+  if (pos == std::string::npos) return fallback;
+  ++pos;
+  while (pos < s.size() && (s[pos] == ' ' || s[pos] == '\t')) ++pos;
+  if (pos >= s.size() || (s[pos] != '-' && !isdigit(static_cast<unsigned char>(s[pos]))))
+    return fallback;
+  return std::strtoll(s.c_str() + pos, nullptr, 10);
 }
 
 bool write_all(int fd, const void* buf, size_t n) {
@@ -91,6 +141,7 @@ class ParameterServer {
     int64_t total = 0;
     for (int64_t s : sizes_) total += s;
     center_.assign(size_t(total), 0.0f);
+    center_bytes_ = total * int64_t(sizeof(float));
     // largest VALID payload a peer may declare: per tensor the larger of
     // the f32 blob (4*size) and the int8 Q blob (4+size, bigger for
     // scalar leaves).  recv_payload caps against this, so a garbage
@@ -179,21 +230,92 @@ class ParameterServer {
   int64_t pull_direct(float* out) {
     std::lock_guard<std::mutex> g(center_mutex_);
     std::memcpy(out, center_.data(), center_.size() * sizeof(float));
+    // counted like the Python hub's pull_direct (inproc pulls land in
+    // ps_pulls_total); snapshot reads use snapshot_direct instead, which
+    // the Python hub's snapshot_state also leaves uncounted
+    ++pulls_;
+    pull_bytes_ += center_bytes_;
     return clock_;
   }
 
-  void commit_direct(const float* flat, int64_t last_pull_clock) {
+  // pull_direct minus the telemetry: the HubSnapshotter's periodic center
+  // read, which must not register as worker pull traffic (metric parity
+  // with the Python hub, whose snapshot_state copies without counting)
+  int64_t snapshot_direct(float* out) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(out, center_.data(), center_.size() * sizeof(float));
+    return clock_;
+  }
+
+  void commit_direct(const float* flat, int64_t last_pull_clock,
+                     int64_t worker = -1) {
     std::vector<const float*> delta(sizes_.size());
     const float* p = flat;
     for (size_t i = 0; i < sizes_.size(); ++i) { delta[i] = p; p += sizes_[i]; }
     {
       std::lock_guard<std::mutex> g(center_mutex_);
-      if (last_pull_clock < clock_fence_) last_pull_clock = clock_fence_;
-      apply_commit(delta.data(), clock_ - last_pull_clock);
+      if (last_pull_clock < clock_fence_) {
+        last_pull_clock = clock_fence_;
+        ++fenced_commits_;
+      }
+      int64_t staleness = clock_ - last_pull_clock;
+      int64_t t0 = mono_ns();
+      apply_commit(delta.data(), staleness);
+      record_commit_locked(worker, staleness, t0);
+      commit_bytes_ += center_bytes_;
       ++clock_;
     }
     num_updates_.fetch_add(1);
   }
+
+  // -- telemetry exports (all under center_mutex_ for a consistent view) ------
+  // layout: [commits, pulls, commit_bytes, pull_bytes, fenced_commits,
+  //          live_workers, idle_evictions, clock, commit_log_dropped]
+  void stats(int64_t out[9]) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    out[0] = commits_;
+    out[1] = pulls_;
+    out[2] = commit_bytes_;
+    out[3] = pull_bytes_;
+    out[4] = fenced_commits_;
+    out[5] = live_members_;
+    out[6] = idle_evictions_;
+    out[7] = clock_;
+    out[8] = log_dropped_;
+  }
+
+  // exact small-integer staleness counts: slots 0..kStaleSlots-1, plus one
+  // overflow slot (the Python wrapper replays deltas into the registry's
+  // log-bucket ps_commit_staleness histogram)
+  static constexpr int kStaleSlots = 64;
+  void staleness_hist(int64_t out[kStaleSlots + 1]) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    std::memcpy(out, stale_hist_, sizeof(stale_hist_));
+  }
+
+  // drain up to max_records commit-log records (oldest first), 5 int64
+  // each: clock, worker (announced via 'T'; -1 if none), staleness,
+  // CLOCK_MONOTONIC ns at apply start, apply duration ns.  The ring is
+  // bounded: with nobody draining it, it simply wraps (oldest records
+  // overwritten), so an untelemetered hub holds steady memory.
+  int64_t drain_commits(int64_t* out, int64_t max_records) {
+    std::lock_guard<std::mutex> g(center_mutex_);
+    int64_t n = 0;
+    while (n < max_records && log_count_ > 0) {
+      const CommitRecord& r = commit_log_[size_t(log_head_)];
+      out[n * 5 + 0] = r.clock;
+      out[n * 5 + 1] = r.worker;
+      out[n * 5 + 2] = r.staleness;
+      out[n * 5 + 3] = r.t_ns;
+      out[n * 5 + 4] = r.dur_ns;
+      log_head_ = (log_head_ + 1) % kLogCapacity;
+      --log_count_;
+      ++n;
+    }
+    return n;
+  }
+
+  int64_t time_ns() const { return mono_ns(); }
 
  private:
   void accept_loop() {
@@ -231,13 +353,14 @@ class ParameterServer {
     }
   }
 
-  bool recv_payload(int fd, std::vector<unsigned char>& payload) {
+  bool recv_payload(int fd, std::vector<unsigned char>& payload,
+                    bool* timed_out = nullptr) {
     unsigned char hdr[8];
-    if (!read_exact(fd, hdr, 8)) return false;
+    if (!read_exact(fd, hdr, 8, timed_out)) return false;
     uint64_t n = be64_decode(hdr);
     if (n > max_payload_) return false;  // garbage/oversized prefix: drop peer
     payload.resize(size_t(n));
-    return n == 0 || read_exact(fd, payload.data(), size_t(n));
+    return n == 0 || read_exact(fd, payload.data(), size_t(n), timed_out);
   }
 
   bool send_simple(int fd, char action) {
@@ -319,6 +442,25 @@ class ParameterServer {
     return off == payload.size();
   }
 
+  // called under center_mutex_: append one commit-log record + the exact
+  // staleness count the wrapper replays into the registry histogram
+  void record_commit_locked(int64_t worker, int64_t staleness, int64_t t0_ns) {
+    ++commits_;
+    int slot = staleness < 0 ? 0
+               : (staleness >= kStaleSlots ? kStaleSlots : int(staleness));
+    ++stale_hist_[slot];
+    CommitRecord r{clock_, worker, staleness, t0_ns, mono_ns() - t0_ns};
+    size_t idx = size_t((log_head_ + log_count_) % kLogCapacity);
+    commit_log_[idx] = r;
+    if (log_count_ == kLogCapacity) {
+      log_head_ = (log_head_ + 1) % kLogCapacity;  // wrap: drop oldest
+      ++log_dropped_;  // surfaced via stats(): a truncated commit log
+                       // must be visible, never silent
+    } else {
+      ++log_count_;
+    }
+  }
+
   // called under center_mutex_ (live_members_ shares that lock)
   void apply_commit(const float** delta, int64_t staleness) {
     float scale = 1.0f;
@@ -346,6 +488,19 @@ class ParameterServer {
     }
   }
 
+  // 'T' reply: action + one 8-byte tensor carrying this hub's
+  // CLOCK_MONOTONIC nanoseconds, sampled as late as possible before the
+  // send so the client's NTP-style midpoint estimate is tight
+  bool send_time(int fd) {
+    unsigned char buf[8 + 1 + 4 + 8 + 8];
+    be64_encode(1 + 4 + 8 + 8, buf);
+    buf[8] = 'T';
+    be32_encode(1, buf + 9);
+    be64_encode(8, buf + 13);
+    be64_encode(uint64_t(mono_ns()), buf + 21);
+    return write_all(fd, buf, sizeof(buf));
+  }
+
   void handle_connection(int fd) {
     int64_t last_pull_clock;
     {
@@ -356,12 +511,14 @@ class ParameterServer {
       last_pull_clock = clock_fence_;
     }
     bool joined = false;
+    int64_t ctx_worker = -1;  // trace context announced via 'T'
     std::vector<unsigned char> payload;
     std::vector<const float*> delta(sizes_.size());
     std::vector<float> qbuf;
     std::vector<float> snap;
+    bool timed_out = false;
     while (running_.load()) {
-      if (!recv_payload(fd, payload) || payload.empty()) break;
+      if (!recv_payload(fd, payload, &timed_out) || payload.empty()) break;
       char action = char(payload[0]);
       if (action == 'P') {
         {
@@ -371,6 +528,8 @@ class ParameterServer {
           std::lock_guard<std::mutex> g(center_mutex_);
           last_pull_clock = clock_;
           snap = center_;
+          ++pulls_;
+          pull_bytes_ += center_bytes_;
         }
         if (!send_weights(fd, snap)) break;
       } else if (action == 'C' || action == 'Q') {
@@ -384,16 +543,37 @@ class ParameterServer {
             joined = true;
             ++live_members_;
           }
-          apply_commit(delta.data(), clock_ - last_pull_clock);
+          int64_t staleness = clock_ - last_pull_clock;
+          int64_t t0 = mono_ns();
+          apply_commit(delta.data(), staleness);
+          record_commit_locked(ctx_worker, staleness, t0);
+          // payload bytes net of framing overhead (5-byte header + one
+          // 8-byte prefix per tensor) — the Python hub's accounting
+          commit_bytes_ += int64_t(payload.size()) - 5 - 8 * int64_t(sizes_.size());
           ++clock_;
         }
         num_updates_.fetch_add(1);
         if (!send_simple(fd, 'A')) break;
       } else if (action == 'H') {  // heartbeat: liveness proof, acked
         if (!send_simple(fd, 'A')) break;
+      } else if (action == 'T') {
+        // trace-context announce: remember the worker for commit-log
+        // attribution, reply with this hub's monotonic clock (the
+        // client's offset estimate rides the round trip)
+        if (payload.size() > 13) {
+          uint64_t blob_len = be64_decode(payload.data() + 5);
+          if (13 + blob_len <= payload.size())
+            ctx_worker = json_int_field(payload.data() + 13, size_t(blob_len),
+                                        "worker_id", -1);
+        }
+        if (!send_time(fd)) break;
       } else {  // 'B' or unknown -> close
         break;
       }
+    }
+    if (timed_out) {
+      std::lock_guard<std::mutex> g(center_mutex_);
+      ++idle_evictions_;
     }
     if (joined) {
       std::lock_guard<std::mutex> g(center_mutex_);
@@ -414,6 +594,19 @@ class ParameterServer {
   int idle_timeout_ms_;
   uint64_t max_payload_ = 0;
   int live_members_ = 0;  // guarded by center_mutex_
+  // telemetry (all guarded by center_mutex_; drained via dk_ps_stats /
+  // dk_ps_staleness_hist / dk_ps_drain_commits)
+  struct CommitRecord {
+    int64_t clock, worker, staleness, t_ns, dur_ns;
+  };
+  static constexpr int64_t kLogCapacity = 8192;
+  int64_t commits_ = 0, pulls_ = 0;
+  int64_t commit_bytes_ = 0, pull_bytes_ = 0;
+  int64_t fenced_commits_ = 0, idle_evictions_ = 0;
+  int64_t center_bytes_ = 0;
+  int64_t stale_hist_[kStaleSlots + 1] = {};
+  std::vector<CommitRecord> commit_log_ = std::vector<CommitRecord>(size_t(kLogCapacity));
+  int64_t log_head_ = 0, log_count_ = 0, log_dropped_ = 0;
   std::vector<int64_t> sizes_;
   std::vector<float> center_;
   std::mutex center_mutex_;
@@ -445,9 +638,27 @@ void dk_ps_set_weights(void* ps, const float* in) { static_cast<ParameterServer*
 int64_t dk_ps_num_updates(void* ps) { return static_cast<ParameterServer*>(ps)->num_updates(); }
 int dk_ps_port(void* ps) { return static_cast<ParameterServer*>(ps)->port(); }
 int64_t dk_ps_pull(void* ps, float* out) { return static_cast<ParameterServer*>(ps)->pull_direct(out); }
+int64_t dk_ps_snapshot(void* ps, float* out) {
+  return static_cast<ParameterServer*>(ps)->snapshot_direct(out);
+}
 void dk_ps_commit(void* ps, const float* flat, int64_t last_pull_clock) {
   static_cast<ParameterServer*>(ps)->commit_direct(flat, last_pull_clock);
 }
+// commit_direct with the caller's trace-context worker id (inproc workers
+// have no connection to announce 'T' on); dk_ps_commit stays as the
+// uncontexted twin so pre-existing callers keep their ABI
+void dk_ps_commit_ctx(void* ps, const float* flat, int64_t last_pull_clock,
+                      int64_t worker) {
+  static_cast<ParameterServer*>(ps)->commit_direct(flat, last_pull_clock, worker);
+}
+void dk_ps_stats(void* ps, int64_t* out8) { static_cast<ParameterServer*>(ps)->stats(out8); }
+void dk_ps_staleness_hist(void* ps, int64_t* out65) {
+  static_cast<ParameterServer*>(ps)->staleness_hist(out65);
+}
+int64_t dk_ps_drain_commits(void* ps, int64_t* out, int64_t max_records) {
+  return static_cast<ParameterServer*>(ps)->drain_commits(out, max_records);
+}
+int64_t dk_ps_time_ns(void* ps) { return static_cast<ParameterServer*>(ps)->time_ns(); }
 void dk_ps_restore(void* ps, const float* flat, int64_t clock, int64_t num_updates) {
   static_cast<ParameterServer*>(ps)->restore(flat, clock, num_updates);
 }

@@ -1,0 +1,41 @@
+"""Child process for the distributed-tracing merge test (not collected by
+pytest).
+
+Plays one remote WORKER process of a fleet: connects a raw ``PSClient``
+(no jax — the import stays light) to a hub owned by the parent process,
+announces a trace context (wire action ``T``), runs a few
+pull/span/commit rounds, and flushes its span ring + clock-offset
+estimate to the shared ``DKT_TRACE_DIR`` for ``merge_traces``.
+
+Usage: python multihost_child_trace.py <ps_port> <worker_id> <trace_dir>
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from distkeras_tpu import observability as obs
+from distkeras_tpu.observability import distributed as dtrace
+from distkeras_tpu.runtime.parameter_server import PSClient
+
+ps_port, worker_id, trace_dir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+obs.enable()
+ctx = dtrace.TraceContext(job_id="mergejob", worker_id=worker_id,
+                          span_id=dtrace.new_span_id())
+dtrace.activate(ctx)
+
+templates = [np.zeros((4, 4), np.float32), np.zeros(3, np.float32)]
+client = PSClient("127.0.0.1", ps_port, templates=templates, trace_context=ctx)
+for w in range(5):
+    with obs.span("async.window", worker=worker_id, window=w):
+        pulled = client.pull()
+        time.sleep(0.002 * (worker_id + 1))  # worker-distinct span widths
+        client.commit([np.full_like(t, 0.01) for t in pulled])
+client.close()
+
+path = dtrace.flush_process_trace(trace_dir, job_id="mergejob", role="worker")
+offset, error = dtrace.clock_sync_state()
+print(f"OK worker={worker_id} path={path} offset_ns={offset} "
+      f"error_ns={error}", flush=True)

@@ -263,6 +263,37 @@ def test_async_recovery_acceptance_block_tripwires():
     assert acc2["restart_loss_parity_ok"] is None
 
 
+def test_observability_acceptance_block_tripwires():
+    """The issue-5 tripwire block: tracing overhead under the 3% target,
+    >=95% commit-context coverage, straggler ranking present — with None
+    (not a crash) wherever a leg is missing."""
+    out = {
+        "overhead_pct": 1.4,
+        "fleet": {"commit_context_coverage": 0.99, "total_commits": 96,
+                  "top_straggler": "1", "workers_seen": 2},
+    }
+    bench._observability_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["overhead_ok"] is True and acc["overhead_pct_target"] == 3.0
+    assert acc["coverage_ok"] is True and acc["coverage_target"] == 0.95
+    assert acc["straggler_ranked"] is True
+
+    out2 = {"overhead_pct": 5.2,
+            "fleet": {"commit_context_coverage": 0.5, "top_straggler": None}}
+    bench._observability_acceptance(out2)
+    acc2 = out2["acceptance"]
+    assert acc2["overhead_ok"] is False
+    assert acc2["coverage_ok"] is False
+    assert acc2["straggler_ranked"] is False
+
+    out3 = {}  # the whole leg errored before measuring anything
+    bench._observability_acceptance(out3)
+    acc3 = out3["acceptance"]
+    assert acc3["overhead_ok"] is None
+    assert acc3["coverage_ok"] is None
+    assert acc3["straggler_ranked"] is None
+
+
 @pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
 def test_moe_acceptance_block_shape():
     """The issue-2 tripwire block: booleans (or None off-TPU) with the
