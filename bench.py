@@ -1323,6 +1323,7 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             "compress": extra.get("compress_commits"),
             "transport": extra.get("transport", "socket"),
             "pipeline": extra.get("pipeline", True),
+            "num_shards": extra.get("num_shards", 1),
             # final-loss parity evidence: pipelined pulls see the center one
             # commit earlier (self-staleness 1), so the issue-3 acceptance
             # records where every leg's trajectory LANDS, not just its speed
@@ -1393,6 +1394,7 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             ("async_adag_serial", AsyncADAG, {"pipeline": False}),
             ("async_adag_native", AsyncADAG, {"native_ps": True}),
             ("async_adag_int8", AsyncADAG, {"compress_commits": "int8"}),
+            ("async_adag_shards4", AsyncADAG, {"num_shards": 4}),
             ("async_aeasgd", AsyncAEASGD, {"rho": 2.0})):
         try:
             async_leg(name, cls, extra)
@@ -1446,8 +1448,212 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
         # ratios below just come back absent
         out["sync_adag"] = {"error": f"{type(ex).__name__}: {ex}"}
 
+    # hub-scaling leg (ISSUE 6): pure PS-level commit throughput at 1 vs 4
+    # center shards — the single-socket/single-lock ceiling measured
+    # directly, without training noise.  Individually fallible like every
+    # other leg
+    try:
+        out["shard_scaling"] = _bench_async_shards()
+    except Exception as ex:
+        out["shard_scaling"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     _async_acceptance(out)
     return out
+
+
+def _shard_bench_hub_proc(shapes, conn):
+    """Child-process entry (spawn-safe, module level): one PS hub process
+    serving one shard's slice — the ``distkeras-ps --shard-index``
+    topology, so the 1-shard leg is bottlenecked by exactly what a real
+    single-hub deployment is (one process's socket stack, lock and
+    interpreter).  Telemetry runs locally; the final stats ride back over
+    the pipe."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.runtime.parameter_server import DeltaParameterServer
+
+    obs.enable()
+    hub = DeltaParameterServer([np.zeros(s, np.float32) for s in shapes],
+                               idle_timeout=None)
+    hub.start()
+    conn.send(hub.port)
+    conn.recv()  # stop request
+    hist = (obs.snapshot()["histograms"]
+            .get('ps_rpc_seconds{rpc="commit"}') or {})
+    conn.send({"num_updates": int(hub.num_updates),
+               "hub_commit_s": hist.get("sum")})
+    hub.stop()
+
+
+def _shard_bench_worker_proc(addrs, shapes, num_shards, commits, max_inflight,
+                             conn):
+    """Child-process entry (spawn-safe, module level): one striped commit
+    blaster.  Ready/go handshake over the pipe keeps process startup and
+    connection warmup out of the timed window."""
+    import numpy as np
+
+    from distkeras_tpu.runtime.parameter_server import (
+        ShardedPSClient, shard_plan)
+
+    templates = [np.zeros(s, np.float32) for s in shapes]
+    delta = [np.full_like(t, 1e-3) for t in templates]
+    plan = shard_plan(templates, num_shards)
+    client = ShardedPSClient(addrs, templates, plan, max_inflight=max_inflight)
+    client.pull()  # connections + landing buffers warm
+    conn.send("ready")
+    conn.recv()  # go
+    for _ in range(commits):
+        client.commit_nowait(delta)
+    client.drain()
+    conn.send("done")
+    client.close()
+
+
+def _bench_async_shards(*, shard_counts=(1, 4), workers: int = 8,
+                        leaves: int = 16, leaf_elems: int = 2048,
+                        commits_per_worker: int = 300, max_inflight: int = 8):
+    """Sharded-hub commit throughput (ISSUE 6 acceptance leg): ``workers``
+    worker PROCESSES blast striped commits at 1 vs 4 hub shard PROCESSES
+    (one Python hub per shard — the ``distkeras-ps --shard-index``
+    deployment shape), and the aggregate throughput ratio is the evidence
+    that partitioning the center removed the single-hub ceiling (target:
+    >= 3x at 4 shards, near-linear).  Processes, not threads, on both
+    sides: in-process workers share one GIL and measure the CLIENT, not
+    the hub.  The payload is deliberately small (16 x 8 KiB leaves) so
+    per-commit hub work — syscalls, decode, lock, ack — is the ceiling
+    rather than loopback bandwidth, which one machine cannot shard.
+    ``cpus`` is recorded because the figure needs ~(workers + shards)
+    runnable processes to mean anything; a 2-core container reports a
+    degraded ratio, the tripwire stays None-degrading, and the real
+    figure comes from bench hardware."""
+    import multiprocessing as mp
+
+    from distkeras_tpu.runtime import networking as net
+    from distkeras_tpu.runtime.parameter_server import shard_plan
+
+    shapes = [(int(leaf_elems),) for _ in range(leaves)]
+    center_bytes = leaves * leaf_elems * 4
+    out = {"workers": workers, "leaves": leaves, "leaf_elems": leaf_elems,
+           "commits_per_worker": commits_per_worker,
+           "center_kb": round(center_bytes / 1024, 1),
+           "hub": "python-process-per-shard",
+           "cpus": os.cpu_count(),
+           "shard_counts": list(shard_counts)}
+    # forkserver when available: children come from a clean server process
+    # (no re-exec of the caller's __main__, safe to start from a threaded
+    # parent); spawn is the portable fallback.  Plain fork is never safe
+    # here — the parent may hold live hub threads
+    try:
+        ctx = mp.get_context("forkserver")
+    except ValueError:
+        ctx = mp.get_context("spawn")
+
+    def one_leg(num_shards: int) -> dict:
+        import numpy as np
+
+        templates = [np.zeros(s, np.float32) for s in shapes]
+        plan = shard_plan(templates, num_shards)
+        hub_pipes, hub_procs, w_pipes, w_procs = [], [], [], []
+        try:
+            for sid in range(num_shards):
+                parent, child = ctx.Pipe()
+                proc = ctx.Process(
+                    target=_shard_bench_hub_proc,
+                    args=([shapes[i] for i in plan.assignments[sid]], child),
+                    daemon=True)
+                proc.start()
+                hub_pipes.append(parent)
+                hub_procs.append(proc)
+            addrs = []
+            for pipe in hub_pipes:
+                if not pipe.poll(60):
+                    raise RuntimeError("hub shard process failed to report "
+                                       "its port within 60s")
+                addrs.append(("127.0.0.1", pipe.recv()))
+            for _ in range(workers):
+                parent, child = ctx.Pipe()
+                proc = ctx.Process(
+                    target=_shard_bench_worker_proc,
+                    args=(addrs, shapes, num_shards, commits_per_worker,
+                          max_inflight, child),
+                    daemon=True)
+                proc.start()
+                w_pipes.append(parent)
+                w_procs.append(proc)
+            for pipe in w_pipes:
+                if not pipe.poll(120):
+                    raise RuntimeError("worker process failed to warm up "
+                                       "within 120s")
+                pipe.recv()
+            t0 = time.perf_counter()
+            for pipe in w_pipes:
+                pipe.send("go")
+            for pipe in w_pipes:
+                if not pipe.poll(300):
+                    raise RuntimeError("worker process did not finish its "
+                                       "commits within 300s")
+                pipe.recv()
+            wall = time.perf_counter() - t0
+            logical = workers * commits_per_worker
+            stripe_bytes = sum(
+                net.tensor_frame_len([templates[i] for i in idxs])
+                for idxs in plan.assignments)
+            per_shard = {}
+            for sid, pipe in enumerate(hub_pipes):
+                pipe.send("stop")
+                stats = pipe.recv() if pipe.poll(30) else {}
+                shard_frame = net.tensor_frame_len(
+                    [templates[i] for i in plan.assignments[sid]])
+                n_commits = int(stats.get("num_updates") or 0)
+                hub_s = stats.get("hub_commit_s")
+                per_shard[str(sid)] = {
+                    "leaves": len(plan.assignments[sid]),
+                    "center_kb": round(plan.shard_bytes[sid] / 1024, 1),
+                    "commits": n_commits,
+                    "wire_mb": round(n_commits * shard_frame / 1e6, 2),
+                    "hub_commit_s": (round(float(hub_s), 4)
+                                     if hub_s is not None else None),
+                }
+            return {
+                "wall_s": round(wall, 4),
+                "logical_commits": logical,
+                "commits_per_sec": round(logical / wall, 2),
+                "mb_per_sec": round(logical * stripe_bytes / 1e6 / wall, 2),
+                "per_shard": per_shard,
+            }
+        finally:
+            for proc in w_procs + hub_procs:
+                proc.join(timeout=10)
+                if proc.is_alive():
+                    proc.terminate()
+
+    for num_shards in shard_counts:
+        try:
+            out[str(num_shards)] = one_leg(int(num_shards))
+        except Exception as ex:
+            out[str(num_shards)] = {"error": f"{type(ex).__name__}: {ex}"}
+    _async_shard_acceptance(out)
+    return out
+
+
+def _async_shard_acceptance(out: dict) -> None:
+    """Attach the ISSUE-6 shard-scaling tripwire, in place: aggregate
+    commit throughput at 4 shards >= 3x the 1-shard figure.  None (not a
+    crash) wherever a leg is missing or errored — the PR-3 convention."""
+    def _ok(name):
+        return isinstance(out.get(name), dict) and "error" not in out[name]
+
+    ratio = None
+    if _ok("1") and _ok("4"):
+        base = out["1"].get("commits_per_sec") or 0
+        if base:
+            ratio = round(out["4"]["commits_per_sec"] / base, 3)
+    out["acceptance"] = {
+        "shard_scaling_target": 3.0,
+        "scaling_x_4_vs_1": ratio,
+        "shard_scaling_ok": None if ratio is None else bool(ratio >= 3.0),
+    }
 
 
 def _async_acceptance(out: dict) -> None:

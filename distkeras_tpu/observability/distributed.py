@@ -344,9 +344,20 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
     - ``ps.reconnect`` spans (worker attr) -> reconnect storms (a worker
       with ``>= storm_threshold`` reconnects is flagged).
 
+    Sharded-hub runs (spans carry a ``shard`` attr): one LOGICAL commit
+    lands as one per-shard span per shard, so per-worker commit counts and
+    the coverage ratio are computed over shard-0 spans only (shard 0 is
+    present in every plan; unsharded spans carry no attr and count as
+    before) — aggregation across shards without double-counting — while
+    the per-shard ``shards`` table consumes every span, ranking shards by
+    mean commit-handler time so a slow shard is as nameable as a slow
+    worker.
+
     Returns a JSON-safe dict: ``workers`` (per-worker stats),
     ``stragglers`` (worker ids, slowest first), ``top_straggler``,
-    ``commit_context_coverage`` and ``reconnect_storms``."""
+    ``commit_context_coverage``, ``reconnect_storms``, and — when any
+    span names a shard — ``shards``, ``shards_ranked`` and
+    ``slowest_shard``."""
     spans = _span_records(events, trace_dir)
 
     def bucket(worker: Any) -> Dict[str, Any]:
@@ -358,7 +369,15 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
                             "reconnects": 0}
         return workers[key]
 
+    def shard_bucket(shard: Any) -> Dict[str, Any]:
+        key = str(shard)
+        if key not in shards:
+            shards[key] = {"commits": 0, "staleness_sum": 0,
+                           "commit_ms_sum": 0.0}
+        return shards[key]
+
     workers: Dict[str, Dict[str, Any]] = {}
+    shards: Dict[str, Dict[str, Any]] = {}
     commits_total = 0
     commits_with_ctx = 0
     for s in spans:
@@ -371,6 +390,18 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             b["window_ms_sum"] += ms
             b["window_ms_max"] = max(b["window_ms_max"], ms)
         elif name == "ps.handle_commit":
+            stale = int(attrs.get("staleness", 0) or 0)
+            shard = attrs.get("shard")
+            if shard is not None:
+                sb = shard_bucket(shard)
+                sb["commits"] += 1
+                sb["staleness_sum"] += stale
+                sb["commit_ms_sum"] += s.get("dur_us", 0) / 1000.0
+            if shard is not None and int(shard) != 0:
+                # per-shard copies of one logical commit: counted in the
+                # shards table above, skipped here so worker totals and
+                # coverage stay logical-commit-denominated
+                continue
             commits_total += 1
             worker = attrs.get("worker")
             if worker is None or int(worker) < 0:
@@ -378,7 +409,6 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             commits_with_ctx += 1
             b = bucket(worker)
             b["commits"] += 1
-            stale = int(attrs.get("staleness", 0) or 0)
             b["staleness_sum"] += stale
             b["staleness_max"] = max(b["staleness_max"], stale)
         elif name == "ps.reconnect" and "worker" in attrs:
@@ -392,12 +422,22 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         b["window_ms_sum"] = round(b["window_ms_sum"], 3)
         b["window_ms_max"] = round(b["window_ms_max"], 3)
 
+    for sb in shards.values():
+        sb["mean_staleness"] = (round(sb["staleness_sum"] / sb["commits"], 3)
+                                if sb["commits"] else None)
+        sb["mean_commit_ms"] = (round(sb["commit_ms_sum"] / sb["commits"], 4)
+                                if sb["commits"] else None)
+        sb["commit_ms_sum"] = round(sb["commit_ms_sum"], 3)
+
     ranked = sorted((w for w, b in workers.items()
                      if b["mean_window_ms"] is not None),
                     key=lambda w: workers[w]["mean_window_ms"], reverse=True)
     storms = sorted(w for w, b in workers.items()
                     if b["reconnects"] >= storm_threshold)
-    return {
+    shards_ranked = sorted(
+        (k for k, sb in shards.items() if sb["mean_commit_ms"] is not None),
+        key=lambda k: shards[k]["mean_commit_ms"], reverse=True)
+    report = {
         "workers": workers,
         "stragglers": ranked,
         "top_straggler": ranked[0] if ranked else None,
@@ -406,3 +446,8 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
                                     if commits_total else None),
         "reconnect_storms": storms,
     }
+    if shards:
+        report["shards"] = shards
+        report["shards_ranked"] = shards_ranked
+        report["slowest_shard"] = shards_ranked[0] if shards_ranked else None
+    return report

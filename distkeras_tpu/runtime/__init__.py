@@ -21,6 +21,7 @@ from distkeras_tpu.runtime.faults import (  # noqa: F401
     Fault,
     FaultPlan,
     InjectedWorkerFault,
+    ShardedChaosProxy,
     WorkerKillPlan,
 )
 from distkeras_tpu.runtime.networking import (  # noqa: F401
@@ -44,5 +45,9 @@ from distkeras_tpu.runtime.parameter_server import (  # noqa: F401
     HubSnapshotter,
     InprocPSClient,
     PSClient,
+    ShardedParameterServer,
+    ShardedPSClient,
+    ShardPlan,
     SocketParameterServer,
+    shard_plan,
 )

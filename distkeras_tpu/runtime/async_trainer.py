@@ -64,7 +64,9 @@ from distkeras_tpu.runtime.parameter_server import (
     DynSGDParameterServer,
     InprocPSClient,
     PSClient,
-    SocketParameterServer,
+    ShardedParameterServer,
+    ShardedPSClient,
+    shard_plan,
 )
 from distkeras_tpu.trainers import Trainer
 from distkeras_tpu.utils import flatten_weights
@@ -110,6 +112,7 @@ class AsyncDistributedTrainer(Trainer):
                  fault_hook: Optional[Callable[[int, int], None]] = None,
                  compress_commits: Optional[str] = None,
                  transport: str = "socket",
+                 num_shards: int = 1,
                  pipeline: bool = True,
                  max_inflight_commits: int = 2,
                  max_reconnects: Optional[int] = None,
@@ -155,9 +158,36 @@ class AsyncDistributedTrainer(Trainer):
             raise ValueError(f"compress_commits must be None or 'int8', "
                              f"got {compress_commits!r}")
         self.compress_commits = compress_commits
+        # sharded hub (ISSUE 6): num_shards > 1 partitions the center
+        # across that many hubs — deterministic size-balanced leaf->shard
+        # assignment (shard_plan), one hub per shard, striped pull/commit.
+        # The default 1 is byte-identical to today's single-hub wire
+        self.num_shards = int(num_shards)
+        if self.num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
         # worker-only mode (multi-host): connect to an external hub at this
-        # (host, port) instead of starting one; see module docstring
-        self.ps_address = tuple(ps_address) if ps_address is not None else None
+        # (host, port) — or, sharded, a SEQUENCE of per-shard (host, port)
+        # pairs aligned with the shard plan (num_shards defaults to the
+        # sequence length) — instead of starting one; see module docstring
+        if ps_address is None:
+            self.ps_address = None
+            self._ps_addresses: Optional[List[Tuple[str, int]]] = None
+        else:
+            addr = list(ps_address)
+            if addr and isinstance(addr[0], (str, bytes)):
+                addrs = [(str(addr[0]), int(addr[1]))]
+            else:
+                addrs = [(str(h), int(p)) for h, p in addr]
+            if len(addrs) > 1 and self.num_shards == 1:
+                self.num_shards = len(addrs)
+            if len(addrs) != self.num_shards:
+                raise ValueError(
+                    f"ps_address has {len(addrs)} shard addresses but "
+                    f"num_shards={self.num_shards}; worker-only sharded mode "
+                    f"needs one (host, port) per shard")
+            self._ps_addresses = addrs
+            self.ps_address = (addrs[0] if len(addrs) == 1
+                               else tuple(addrs))
         self.checkpoint_interval = float(checkpoint_interval)
         # failure policy (SURVEY §5 "failure detection" — the reference had
         # none; Spark silently re-ran dead executors).  "raise" surfaces the
@@ -220,13 +250,26 @@ class AsyncDistributedTrainer(Trainer):
         # (mirrors DistributedTrainer._engine)
 
     # -- factories (reference: allocate_worker / allocate_parameter_server) ---
-    def allocate_parameter_server(self, weights: List[np.ndarray]) -> Any:
+    def allocate_parameter_server(self, weights: List[np.ndarray],
+                                  shard_id: Optional[int] = None) -> Any:
         raise NotImplementedError  # pragma: no cover - interface
 
-    def _hub_kwargs(self) -> dict:
-        """Fault-tolerance kwargs every trainer-owned hub (Python or C++)
-        takes; subclass allocators splat this into their constructor."""
-        return {"idle_timeout": self.ps_idle_timeout}
+    def _hub_kwargs(self, shard_id: Optional[int] = None) -> dict:
+        """Fault-tolerance + identity kwargs every trainer-owned hub
+        (Python or C++) takes; subclass allocators splat this into their
+        constructor.  ``shard_id`` tags a sharded hub's telemetry (None on
+        the unsharded path — the exact pre-sharding series)."""
+        return {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id}
+
+    def _allocate_hub(self, weights: List[np.ndarray],
+                      plan) -> Any:
+        """One hub (num_shards=1) or the sharded facade — each shard built
+        by the subclass's algorithm-specific allocator over its slice."""
+        if plan is None:
+            return self.allocate_parameter_server(weights)
+        return ShardedParameterServer(
+            weights, plan,
+            lambda w, sid: self.allocate_parameter_server(w, shard_id=sid))
 
     # -- the algorithm's window-boundary math, ON DEVICE -----------------------
     # Both hooks take parameter PYTREES already resident on the worker's
@@ -321,14 +364,31 @@ class AsyncDistributedTrainer(Trainer):
                 f"async trainers require float32 parameters (PS center is "
                 f"float32); found dtypes {sorted(bad)} — cast the model's "
                 f"params or use the mesh trainers in distkeras_tpu.trainers")
+        flat_f32 = [w.astype(np.float32) for w in flat0]
+        # leaf->shard assignment (deterministic in the model's leaf
+        # layout): both ends of a sharded deployment derive the same plan,
+        # so worker-only mode agrees with standalone --shard-index hubs
+        plan = (shard_plan(flat_f32, self.num_shards)
+                if self.num_shards > 1 else None)
+        self._shard_plan = plan
         if self.ps_address is not None:
             ps = None
-            ps_host, ps_port = self.ps_address
+            addresses = list(self._ps_addresses)
         else:
-            ps = self.allocate_parameter_server([w.astype(np.float32) for w in flat0])
+            ps = self._allocate_hub(flat_f32, plan)
             ps.start()
-            ps_host, ps_port = "127.0.0.1", ps.port
+            addresses = [("127.0.0.1", p)
+                         for p in (ps.ports if plan is not None else [ps.port])]
         self.parameter_server = ps
+
+        def control_client(**kw):
+            """A fresh blocking client for control-plane reads (center
+            snapshots, the worker-only final pull): striped when sharded,
+            the plain PSClient otherwise."""
+            if plan is not None:
+                return ShardedPSClient(addresses, flat0, plan, **kw)
+            return PSClient(addresses[0][0], addresses[0][1],
+                            templates=flat0, **kw)
         # distributed tracing: one job id for every worker this run spawns
         # (explicit trace_context joins multi-host workers under one job).
         # Resolved once here so a restarted worker keeps the job identity.
@@ -400,8 +460,20 @@ class AsyncDistributedTrainer(Trainer):
                 client = InprocPSClient(ps, templates=flat0,
                                         compress=self.compress_commits,
                                         trace_context=ctx)
+            elif plan is not None:
+                # striped worker: one pipelined connection per shard,
+                # pulls/commits fan out and land per shard (the same
+                # zero-copy machinery per connection)
+                client = ShardedPSClient(addresses, flat0, plan,
+                                         compress=self.compress_commits,
+                                         max_inflight=self.max_inflight_commits,
+                                         max_reconnects=self.max_reconnects,
+                                         reconnect_backoff=self.reconnect_backoff,
+                                         heartbeat_interval=self.heartbeat_interval,
+                                         trace_context=ctx)
             else:
-                client = PSClient(ps_host, ps_port, templates=flat0,
+                client = PSClient(addresses[0][0], addresses[0][1],
+                                  templates=flat0,
                                   compress=self.compress_commits,
                                   max_inflight=self.max_inflight_commits,
                                   max_reconnects=self.max_reconnects,
@@ -563,7 +635,7 @@ class AsyncDistributedTrainer(Trainer):
             def get_center():
                 if ps is not None:
                     return ps.get_weights()
-                with PSClient(ps_host, ps_port, templates=flat0) as c:
+                with control_client() as c:
                     return c.pull()
 
             next_step = [(checkpointer.latest_step() or 0) + 1]
@@ -606,9 +678,9 @@ class AsyncDistributedTrainer(Trainer):
             # worker-only mode: the external hub outlives us; read the center
             # (with the run's reconnect budget — a hub restart racing the
             # end of the run must not lose an otherwise-complete result)
-            with PSClient(ps_host, ps_port, templates=flat0,
-                          max_reconnects=self.max_reconnects,
-                          reconnect_backoff=self.reconnect_backoff) as final_client:
+            with control_client(
+                    max_reconnects=self.max_reconnects,
+                    reconnect_backoff=self.reconnect_backoff) as final_client:
                 final = final_client.pull()
         else:
             final = ps.get_weights()
@@ -645,13 +717,13 @@ class AsyncDOWNPOUR(AsyncDistributedTrainer):
     """DOWNPOUR with real asynchrony (reference §2.5): train from the fresh
     center, commit the raw accumulated delta."""
 
-    def allocate_parameter_server(self, weights):
+    def allocate_parameter_server(self, weights, shard_id=None):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DELTA, NativeParameterServer
 
             return NativeParameterServer(weights, mode=MODE_DELTA,
-                                         **self._hub_kwargs())
-        return DeltaParameterServer(weights, **self._hub_kwargs())
+                                         **self._hub_kwargs(shard_id))
+        return DeltaParameterServer(weights, **self._hub_kwargs(shard_id))
 
     def device_commit(self, pulled, local_after):
         delta = jax.tree.map(lambda l, p: l - p, local_after, pulled)
@@ -662,29 +734,30 @@ class AsyncADAG(AsyncDOWNPOUR):
     """ADAG (reference §2.6): DOWNPOUR-style worker, PS normalizes each
     delta by num_workers."""
 
-    def allocate_parameter_server(self, weights):
+    def allocate_parameter_server(self, weights, shard_id=None):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_ADAG, NativeParameterServer
 
             return NativeParameterServer(weights, mode=MODE_ADAG,
                                          num_workers=self.num_workers,
                                          elastic=self.elastic,
-                                         **self._hub_kwargs())
+                                         **self._hub_kwargs(shard_id))
         return ADAGParameterServer(weights, num_workers=self.num_workers,
-                                   elastic=self.elastic, **self._hub_kwargs())
+                                   elastic=self.elastic,
+                                   **self._hub_kwargs(shard_id))
 
 
 class AsyncDynSGD(AsyncDOWNPOUR):
     """DynSGD (reference §2.7): DOWNPOUR-style worker, PS scales each delta
     by 1/(staleness+1) from its commit clock."""
 
-    def allocate_parameter_server(self, weights):
+    def allocate_parameter_server(self, weights, shard_id=None):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DYNSGD, NativeParameterServer
 
             return NativeParameterServer(weights, mode=MODE_DYNSGD,
-                                         **self._hub_kwargs())
-        return DynSGDParameterServer(weights, **self._hub_kwargs())
+                                         **self._hub_kwargs(shard_id))
+        return DynSGDParameterServer(weights, **self._hub_kwargs(shard_id))
 
 
 class AsyncAEASGD(AsyncDistributedTrainer):
@@ -706,13 +779,13 @@ class AsyncAEASGD(AsyncDistributedTrainer):
         self.rho = float(rho)
         self.alpha = self.rho * self.learning_rate
 
-    def allocate_parameter_server(self, weights):
+    def allocate_parameter_server(self, weights, shard_id=None):
         if self.native_ps:
             from distkeras_tpu.runtime.native import MODE_DELTA, NativeParameterServer
 
             return NativeParameterServer(weights, mode=MODE_DELTA,
-                                         **self._hub_kwargs())
-        return DeltaParameterServer(weights, **self._hub_kwargs())
+                                         **self._hub_kwargs(shard_id))
+        return DeltaParameterServer(weights, **self._hub_kwargs(shard_id))
 
     def device_window_start(self, pulled, local):
         return local  # elastic workers keep their own trajectory

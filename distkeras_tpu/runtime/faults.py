@@ -56,7 +56,12 @@ class Fault:
       intact (a congested or GC-pausing peer).
     - ``truncate``: forward the 8-byte header plus ``keep_bytes`` of the
       payload, then sever (a peer that died MID-frame — the shape that
-      desynchronizes a stream and provokes half-read hangs)."""
+      desynchronizes a stream and provokes half-read hangs).
+
+    ``shard`` targets one shard of a sharded-hub deployment: a
+    :class:`ShardedChaosProxy` routes each fault to the proxy in front of
+    that shard's hub (the default 0 is also the only shard of an
+    unsharded :class:`ChaosProxy`, which ignores the field)."""
 
     conn: int
     frame: int
@@ -64,6 +69,7 @@ class Fault:
     kind: str = SEVER
     delay_s: float = 0.05
     keep_bytes: int = 0
+    shard: int = 0
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -321,7 +327,67 @@ class InjectedWorkerFault(RuntimeError):
     one and not an incidental bug."""
 
 
+class ShardedChaosProxy:
+    """One :class:`ChaosProxy` per shard hub: clients connect to
+    ``proxy.ports[s]`` instead of shard ``s``'s real port, and the shared
+    ``plan``'s faults are routed to the proxy fronting ``fault.shard`` —
+    so a chaos test can sever exactly one shard connection of a striped
+    worker while the other stripes keep flowing (the partial-stripe
+    failure mode only a sharded hub has).
+
+    ``upstreams`` is one ``(host, port)`` per shard, aligned with the
+    deployment's :class:`~distkeras_tpu.runtime.parameter_server.
+    ShardPlan`.  Accept ordinals and frame counts stay PER SHARD PROXY —
+    conn 0 is each shard's first accepted connection, exactly as with a
+    single :class:`ChaosProxy`."""
+
+    def __init__(self, upstreams: Sequence[Tuple[str, int]],
+                 plan: Optional[FaultPlan] = None,
+                 host: str = "127.0.0.1"):
+        plan = plan or FaultPlan()
+        self.plan = plan
+        self.proxies: List[ChaosProxy] = []
+        for sid, (up_host, up_port) in enumerate(upstreams):
+            shard_faults = [f for f in plan.faults if f.shard == sid]
+            self.proxies.append(ChaosProxy(
+                up_host, up_port,
+                plan=FaultPlan(shard_faults, seed=plan.seed), host=host))
+
+    @property
+    def ports(self) -> List[int]:
+        return [p.port for p in self.proxies]
+
+    @property
+    def faults_fired(self) -> List[Fault]:
+        return [f for p in self.proxies for f in p.faults_fired]
+
+    def start(self) -> "ShardedChaosProxy":
+        started = []
+        try:
+            for p in self.proxies:
+                p.start()
+                started.append(p)
+        except BaseException:
+            for p in started:
+                try:
+                    p.stop()
+                except Exception:
+                    pass
+            raise
+        return self
+
+    def stop(self) -> None:
+        for p in self.proxies:
+            p.stop()
+
+    def __enter__(self) -> "ShardedChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
 __all__ = [
-    "Fault", "FaultPlan", "ChaosProxy", "WorkerKillPlan",
+    "Fault", "FaultPlan", "ChaosProxy", "ShardedChaosProxy", "WorkerKillPlan",
     "InjectedWorkerFault", "SEVER", "DELAY", "TRUNCATE",
 ]

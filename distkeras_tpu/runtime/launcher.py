@@ -31,7 +31,7 @@ processes on CPU (2 processes x 2 virtual devices), the CI stand-in for
 from __future__ import annotations
 
 import os
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional
 
 import numpy as np
 
@@ -99,7 +99,9 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            idle_timeout: Optional[float] = 300.0,
                            snapshot_dir: Optional[str] = None,
                            snapshot_interval: float = 30.0,
-                           restore: bool = False) -> Any:
+                           restore: bool = False,
+                           num_shards: int = 1,
+                           shard_index: Optional[int] = None) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -115,29 +117,71 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     backoff land on the recovered center.  ``idle_timeout`` evicts
     half-open connections; ``elastic`` (adag) normalizes commits by the
     live worker count instead of ``num_workers``.
+
+    Sharded hub (``num_shards > 1``): the center is partitioned by the
+    deterministic :func:`~distkeras_tpu.runtime.parameter_server.
+    shard_plan` — the same plan the trainers derive from the same model,
+    so no plan travels on the wire.  ``shard_index=i`` serves ONLY shard
+    ``i``'s slice from this process (one ``distkeras-ps`` per shard, the
+    scale-out topology); ``shard_index=None`` starts all shards in this
+    process behind a :class:`~distkeras_tpu.runtime.parameter_server.
+    ShardedParameterServer` facade (read ``.ports``).  When sharded,
+    ``snapshot_dir`` gets a ``shard-NN`` subdirectory per shard so the
+    per-shard snapshot sets never collide.
     """
+    from distkeras_tpu.runtime.parameter_server import (
+        ShardedParameterServer, shard_plan)
     from distkeras_tpu.utils import flatten_weights
 
     flat, _ = flatten_weights(model.params)
     weights = [np.asarray(w, dtype=np.float32) for w in flat]
-    common = dict(idle_timeout=idle_timeout, snapshot_dir=snapshot_dir,
-                  snapshot_interval=snapshot_interval, restore=restore)
-    if native:
-        from distkeras_tpu.runtime.native import (
-            MODE_ADAG, MODE_DELTA, MODE_DYNSGD, NativeParameterServer)
+    num_shards = int(num_shards)
+    if shard_index is not None and not (0 <= int(shard_index) < num_shards):
+        raise ValueError(f"shard_index={shard_index} out of range for "
+                         f"num_shards={num_shards}")
 
-        native_mode = {"delta": MODE_DELTA, "adag": MODE_ADAG, "dynsgd": MODE_DYNSGD}[mode]
-        # the C++ hub binds all interfaces; host selection is Python-hub only
-        ps = NativeParameterServer(weights, mode=native_mode, num_workers=num_workers,
-                                   port=port, elastic=elastic, **common)
-    else:
+    def make_hub(hub_weights, shard_id, hub_port):
+        shard_snap = snapshot_dir
+        if shard_snap is not None and shard_id is not None:
+            shard_snap = os.path.join(shard_snap, f"shard-{shard_id:02d}")
+        common = dict(idle_timeout=idle_timeout, snapshot_dir=shard_snap,
+                      snapshot_interval=snapshot_interval, restore=restore,
+                      shard_id=shard_id)
+        if native:
+            from distkeras_tpu.runtime.native import (
+                MODE_ADAG, MODE_DELTA, MODE_DYNSGD, NativeParameterServer)
+
+            native_mode = {"delta": MODE_DELTA, "adag": MODE_ADAG,
+                           "dynsgd": MODE_DYNSGD}[mode]
+            # the C++ hub binds all interfaces; host selection is
+            # Python-hub only
+            return NativeParameterServer(hub_weights, mode=native_mode,
+                                         num_workers=num_workers,
+                                         port=hub_port, elastic=elastic,
+                                         **common)
         from distkeras_tpu.runtime.parameter_server import (
             ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer)
 
         cls = {"delta": DeltaParameterServer, "adag": ADAGParameterServer,
                "dynsgd": DynSGDParameterServer}[mode]
-        kwargs = {"num_workers": num_workers, "elastic": elastic} if mode == "adag" else {}
-        ps = cls(weights, host=host, port=port, **kwargs, **common)
+        kwargs = ({"num_workers": num_workers, "elastic": elastic}
+                  if mode == "adag" else {})
+        return cls(hub_weights, host=host, port=hub_port, **kwargs, **common)
+
+    if num_shards == 1:
+        ps = make_hub(weights, None, port)
+    else:
+        plan = shard_plan(weights, num_shards)
+        if shard_index is not None:
+            sid = int(shard_index)
+            ps = make_hub([weights[i] for i in plan.assignments[sid]],
+                          sid, port)
+        else:
+            # all shards in one process: consecutive ports from --port, or
+            # all-ephemeral when port=0 (a fixed port can only bind once)
+            ps = ShardedParameterServer(
+                weights, plan,
+                lambda w, sid: make_hub(w, sid, port + sid if port else 0))
     ps.start()
     return ps
 
@@ -175,9 +219,22 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--elastic", action="store_true",
                         help="adag: normalize commits by the LIVE worker "
                              "count instead of --num-workers")
+    parser.add_argument("--num-shards", type=int, default=1,
+                        help="partition the center across this many hub "
+                             "shards (deterministic shard_plan; trainers "
+                             "pass the same num_shards)")
+    parser.add_argument("--shard-index", type=int, default=None,
+                        help="serve ONLY this shard from this process (one "
+                             "distkeras-ps per shard); omit to serve every "
+                             "shard from one process")
     args = parser.parse_args(argv)
     if args.restore and not args.snapshot_dir:
         parser.error("--restore requires --snapshot-dir")
+    if args.shard_index is not None and args.num_shards <= 1:
+        parser.error("--shard-index requires --num-shards > 1")
+    if args.save_final and args.shard_index is not None:
+        parser.error("--save-final needs the full center; a single-shard "
+                     "process only holds its slice")
 
     from distkeras_tpu.models.base import Model
 
@@ -190,8 +247,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                                               if args.idle_timeout > 0 else None),
                                 snapshot_dir=args.snapshot_dir,
                                 snapshot_interval=args.snapshot_interval,
-                                restore=args.restore)
-    print(f"ps listening on {args.host}:{ps.port}", flush=True)
+                                restore=args.restore,
+                                num_shards=args.num_shards,
+                                shard_index=args.shard_index)
+    if args.num_shards > 1 and args.shard_index is None:
+        for sid, p in enumerate(ps.ports):
+            print(f"ps shard {sid}/{args.num_shards} listening on "
+                  f"{args.host}:{p}", flush=True)
+    elif args.shard_index is not None:
+        print(f"ps shard {args.shard_index}/{args.num_shards} listening on "
+              f"{args.host}:{ps.port}", flush=True)
+    else:
+        print(f"ps listening on {args.host}:{ps.port}", flush=True)
     try:
         while True:
             time.sleep(1)

@@ -168,7 +168,8 @@ class NativeParameterServer:
                  snapshot_dir: Optional[str] = None,
                  snapshot_interval: float = 30.0,
                  snapshot_keep: int = 3,
-                 restore: bool = False):
+                 restore: bool = False,
+                 shard_id: Optional[int] = None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native PS unavailable: {build_error()}")
@@ -193,6 +194,12 @@ class NativeParameterServer:
         self._last_stats = [0] * 9
         self._last_stale_hist = [0] * 65
         self._drain_buf = np.zeros(4096 * 5, np.int64)
+        # sharded-hub identity: mirrors the Python hub — when serving one
+        # shard of a partitioned center, every synced metric/span carries
+        # the shard label (None = the exact pre-sharding series)
+        self.shard_id = None if shard_id is None else int(shard_id)
+        self._mlabels = ({} if shard_id is None
+                         else {"shard": str(int(shard_id))})
         self._restore = bool(restore)
         self.snapshotter = None
         if restore and snapshot_dir is None:
@@ -249,6 +256,9 @@ class NativeParameterServer:
             self._started = False
 
     # -- telemetry bridge (dk_ps_stats and friends) ----------------------------
+    def _shard_attrs(self) -> Dict[str, int]:
+        return {} if self.shard_id is None else {"shard": self.shard_id}
+
     _STAT_KEYS = ("commits", "pulls", "commit_bytes", "pull_bytes",
                   "fenced_commits", "live_workers", "idle_evictions", "clock",
                   "commit_log_dropped")
@@ -295,14 +305,15 @@ class NativeParameterServer:
                               ("commit_log_dropped",
                                "ps_commit_log_dropped_total")):
                 if delta[key] > 0:
-                    obs.counter(name).inc(delta[key])
-            obs.gauge("ps_live_workers").set(stats["live_workers"])
+                    obs.counter(name, **self._mlabels).inc(delta[key])
+            obs.gauge("ps_live_workers",
+                      **self._mlabels).set(stats["live_workers"])
             # exact small-integer staleness counts -> the shared log-bucket
             # histogram (value == slot; the overflow slot observes as its
             # lower bound, a documented approximation)
             hist = (ctypes.c_int64 * 65)()
             self._lib.dk_ps_staleness_hist(self._handle, hist)
-            stale = obs.histogram("ps_commit_staleness")
+            stale = obs.histogram("ps_commit_staleness", **self._mlabels)
             for slot in range(65):
                 # bulk replay: O(65) per sync regardless of commit count
                 stale.observe_n(slot, int(hist[slot]) - self._last_stale_hist[slot])
@@ -319,7 +330,7 @@ class NativeParameterServer:
                     clock, worker, staleness, t_ns, dur_ns = \
                         (int(v) for v in self._drain_buf[i * 5:i * 5 + 5])
                     attrs = {"staleness": staleness, "clock": clock,
-                             "hub": "native"}
+                             "hub": "native", **self._shard_attrs()}
                     if worker >= 0:
                         attrs["worker"] = worker
                     obs.TRACER.record_span("ps.handle_commit", t_ns,

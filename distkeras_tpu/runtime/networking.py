@@ -376,6 +376,18 @@ def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:
     return 5 + sum(8 + np.asarray(a).nbytes for a in arrays)
 
 
+def tensor_frame_len(templates: Sequence[np.ndarray]) -> int:
+    """Full on-the-wire size (8-byte header included) of one tensor frame
+    carrying exactly ``templates``' payloads — the ``payload_hint`` every
+    PS/client socket is tuned with (:func:`configure_socket`).  Kept next
+    to the layout so the hub's accounting, the codec's ``frame_len`` and
+    socket-buffer sizing can never drift apart.  Under the sharded hub
+    each shard connection is hinted with ITS tensor subset, so N shard
+    connections cost roughly one model's worth of kernel buffers in
+    total, not N models' worth."""
+    return 8 + encoded_tensors_size(templates)
+
+
 class FlatFrameCodec:
     """Zero-copy tensor framing for a FIXED schema (the PS hot path).
 

@@ -28,11 +28,20 @@ Server classes mirror the reference's:
 ``DeltaParameterServer`` (unscaled adds — DOWNPOUR, elastic),
 ``ADAGParameterServer`` (delta / num_workers),
 ``DynSGDParameterServer`` (delta / (staleness + 1) with a global clock).
+
+The hub also scales OUT (ISSUE 6, ARCHITECTURE.md "Sharded hub"): a
+deterministic, size-balanced leaf->shard assignment (:func:`shard_plan`)
+partitions the center across N hub shards — one hub, lock, listener and
+commit clock per shard (:class:`ShardedParameterServer` owns the set) —
+and :class:`ShardedPSClient` stripes every pull/commit across per-shard
+connections reusing the same pipelined/zero-copy machinery per
+connection.  ``num_shards=1`` is byte-identical to the single-hub wire.
 """
 
 from __future__ import annotations
 
 import contextlib
+import heapq
 import random
 import socket
 import threading
@@ -159,11 +168,24 @@ class SocketParameterServer:
                  snapshot_dir: Optional[str] = None,
                  snapshot_interval: float = 30.0,
                  snapshot_keep: int = 3,
-                 restore: bool = False):
+                 restore: bool = False,
+                 shard_id: Optional[int] = None):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
         self.num_updates = 0
+        # sharded-hub identity (ISSUE 6): when this hub serves one shard of
+        # a partitioned center, every span and metric it emits carries the
+        # shard label so a slow shard is as nameable as a slow worker —
+        # and so per-shard counters stay separate series that aggregators
+        # can sum (bytes) or max (logical commits) without double-counting.
+        # None (the default, and the whole num_shards=1 path) emits the
+        # exact pre-sharding unlabeled series
+        self.shard_id = None if shard_id is None else int(shard_id)
+        self._shard_attrs = ({} if shard_id is None
+                             else {"shard": int(shard_id)})
+        self._mlabels = ({} if shard_id is None
+                         else {"shard": str(int(shard_id))})
         self._clock = 0  # total commits applied (DynSGD's global clock)
         # restore-time fence: connections and inproc clients born before a
         # hub restart carry pull clocks from the PREVIOUS incarnation;
@@ -180,8 +202,10 @@ class SocketParameterServer:
         self._running = False
         self._center_bytes = sum(w.nbytes for w in self.center)
         # full flat-frame size of a pull reply / f32 commit (header, action,
-        # count, per-tensor prefixes, payload) — the socket-buffer hint
-        self._frame_bytes = 13 + sum(8 + w.nbytes for w in self.center)
+        # count, per-tensor prefixes, payload) — the socket-buffer hint.
+        # A shard hub computes this from ITS center subset, so per-shard
+        # connections get per-shard-sized kernel buffers
+        self._frame_bytes = net.tensor_frame_len(self.center)
         # largest VALID payload a peer may declare.  Per tensor that is
         # the larger of the f32 blob (4*size) and the int8 Q blob
         # (4 + size — bigger for SCALAR leaves).  The handler receives
@@ -333,7 +357,8 @@ class SocketParameterServer:
         with self._member_lock:
             self._members[token] = time.monotonic()
         if obs.enabled():
-            obs.gauge("ps_live_workers").set(self.live_workers())
+            obs.gauge("ps_live_workers",
+                      **self._mlabels).set(self.live_workers())
 
     def _member_touch(self, token: int) -> None:
         with self._member_lock:
@@ -344,7 +369,8 @@ class SocketParameterServer:
         with self._member_lock:
             self._members.pop(token, None)
         if obs.enabled():
-            obs.gauge("ps_live_workers").set(self.live_workers())
+            obs.gauge("ps_live_workers",
+                      **self._mlabels).set(self.live_workers())
 
     def live_workers(self) -> int:
         """Workers currently believed alive: joined (committed at least
@@ -460,8 +486,10 @@ class SocketParameterServer:
                     # traffic): evict — half-open peers must not hold a
                     # handler thread and a membership slot forever
                     if obs.enabled():
-                        obs.counter("ps_idle_evictions_total").inc()
-                        with obs.span("ps.evict", conn=conn_idx, **ctx_attrs):
+                        obs.counter("ps_idle_evictions_total",
+                                    **self._mlabels).inc()
+                        with obs.span("ps.evict", conn=conn_idx,
+                                      **self._shard_attrs, **ctx_attrs):
                             pass
                     break
                 action, blobs = net.decode_tensor_views(payload)
@@ -470,7 +498,8 @@ class SocketParameterServer:
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
-                    with obs.span("ps.handle_pull", conn=conn_idx, **ctx_attrs):
+                    with obs.span("ps.handle_pull", conn=conn_idx,
+                                  **self._shard_attrs, **ctx_attrs):
                         with self._lock:
                             # pack the center STRAIGHT into the reply frame
                             # (one memcpy per tensor) under the lock; the
@@ -480,9 +509,11 @@ class SocketParameterServer:
                             last_pull_clock = self._clock
                         reply.send_packed(conn)
                     if telemetry:
-                        obs.counter("ps_pulls_total").inc()
-                        obs.counter("ps_pull_bytes_total").inc(self._center_bytes)
-                        obs.histogram("ps_rpc_seconds", rpc="pull").observe(
+                        obs.counter("ps_pulls_total", **self._mlabels).inc()
+                        obs.counter("ps_pull_bytes_total",
+                                    **self._mlabels).inc(self._center_bytes)
+                        obs.histogram("ps_rpc_seconds", rpc="pull",
+                                      **self._mlabels).observe(
                             time.perf_counter() - t0)
                 elif action in (net.ACTION_COMMIT, net.ACTION_QCOMMIT):
                     delta = (self._decode_delta(blobs)
@@ -495,7 +526,7 @@ class SocketParameterServer:
                         joined = True
                         self._member_join(member_token)
                     with obs.span("ps.handle_commit", conn=conn_idx,
-                                  **ctx_attrs) as sp:
+                                  **self._shard_attrs, **ctx_attrs) as sp:
                         with self._lock:
                             staleness = self._clock - last_pull_clock
                             self.apply_commit(delta, staleness)
@@ -508,19 +539,22 @@ class SocketParameterServer:
                             # joins it to the announcing worker)
                             sp.attrs["staleness"] = staleness
                     if telemetry:
-                        obs.counter("ps_commits_total").inc()
-                        obs.counter("ps_commit_bytes_total").inc(
+                        obs.counter("ps_commits_total", **self._mlabels).inc()
+                        obs.counter("ps_commit_bytes_total",
+                                    **self._mlabels).inc(
                             sum(b.nbytes for b in blobs))
-                        obs.histogram("ps_rpc_seconds", rpc="commit").observe(
+                        obs.histogram("ps_rpc_seconds", rpc="commit",
+                                      **self._mlabels).observe(
                             time.perf_counter() - t0)
                         # per-connection staleness: commits the hub applied
                         # between this worker's last pull and its commit —
                         # the quantity DynSGD scales by, now visible for
                         # EVERY hub flavor.  Created lazily so a hub with
                         # telemetry off never registers per-connection state
-                        obs.gauge("ps_staleness",
-                                  conn=str(conn_idx)).set(staleness)
-                        obs.histogram("ps_commit_staleness").observe(staleness)
+                        obs.gauge("ps_staleness", conn=str(conn_idx),
+                                  **self._mlabels).set(staleness)
+                        obs.histogram("ps_commit_staleness",
+                                      **self._mlabels).observe(staleness)
                 elif action == net.ACTION_TRACE:
                     # trace-context announce: tag this connection's spans
                     # with the worker's identity and reply with this hub's
@@ -579,13 +613,14 @@ class SocketParameterServer:
         # the inproc call runs IN the worker's thread, so the committing
         # worker's thread-local trace context IS the right attribution
         with obs.span("ps.handle_pull", transport="inproc",
-                      **dtrace.current_span_attrs()):
+                      **self._shard_attrs, **dtrace.current_span_attrs()):
             with self._lock:
                 snapshot = [w.copy() for w in self.center]
                 clock = self._clock
         if telemetry:
-            obs.counter("ps_pulls_total").inc()
-            obs.histogram("ps_rpc_seconds", rpc="pull.inproc").observe(
+            obs.counter("ps_pulls_total", **self._mlabels).inc()
+            obs.histogram("ps_rpc_seconds", rpc="pull.inproc",
+                          **self._mlabels).observe(
                 time.perf_counter() - t0)
         return snapshot, clock
 
@@ -605,7 +640,7 @@ class SocketParameterServer:
         arrays = [np.asarray(d, np.float32).reshape(c.shape)
                   for d, c in zip(delta, self.center)]
         with obs.span("ps.handle_commit", transport="inproc",
-                      **dtrace.current_span_attrs()) as sp:
+                      **self._shard_attrs, **dtrace.current_span_attrs()) as sp:
             with self._lock:
                 if last_pull_clock < self._clock_fence:
                     # pre-restart pull clock: fence it at the restore point —
@@ -613,7 +648,8 @@ class SocketParameterServer:
                     # instead of a clock from a dead incarnation
                     last_pull_clock = self._clock_fence
                     if telemetry:
-                        obs.counter("ps_fenced_commits_total").inc()
+                        obs.counter("ps_fenced_commits_total",
+                                    **self._mlabels).inc()
                 staleness = self._clock - last_pull_clock
                 self.apply_commit(arrays, staleness)
                 self.num_updates += 1
@@ -621,10 +657,12 @@ class SocketParameterServer:
             if getattr(sp, "attrs", None) is not None:
                 sp.attrs["staleness"] = staleness
         if telemetry:
-            obs.counter("ps_commits_total").inc()
-            obs.histogram("ps_rpc_seconds", rpc="commit.inproc").observe(
+            obs.counter("ps_commits_total", **self._mlabels).inc()
+            obs.histogram("ps_rpc_seconds", rpc="commit.inproc",
+                          **self._mlabels).observe(
                 time.perf_counter() - t0)
-            obs.histogram("ps_commit_staleness").observe(staleness)
+            obs.histogram("ps_commit_staleness",
+                          **self._mlabels).observe(staleness)
 
     # -- commit rules ----------------------------------------------------------
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:  # pragma: no cover
@@ -786,11 +824,19 @@ class PSClient:
                  reconnect_backoff: float = 0.1,
                  reconnect_backoff_max: float = 5.0,
                  heartbeat_interval: Optional[float] = None,
-                 trace_context: Optional["dtrace.TraceContext"] = None):
+                 trace_context: Optional["dtrace.TraceContext"] = None,
+                 shard_id: Optional[int] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
         self.compress = compress
+        # per-shard connection of a striped client (ShardedPSClient): every
+        # client-side metric/span carries the shard label so the per-shard
+        # wall/wire decomposition is readable straight off the registry.
+        # None (all unsharded callers) emits the exact pre-sharding series
+        self.shard_id = None if shard_id is None else int(shard_id)
+        self._mlabels = ({} if shard_id is None
+                         else {"shard": str(int(shard_id))})
         self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
                           if compress else None)
         self._codec = net.FlatFrameCodec(self.templates)
@@ -1014,11 +1060,12 @@ class PSClient:
             # fleet_report can attribute reconnect storms to a worker
             wattrs = (self.trace_context.span_attrs()
                       if self.trace_context is not None else {})
-            obs.counter("ps.reconnects").inc()
-            obs.histogram("ps.reconnect_ms").observe(
+            obs.counter("ps.reconnects", **self._mlabels).inc()
+            obs.histogram("ps.reconnect_ms", **self._mlabels).observe(
                 (time.perf_counter() - t_fault) * 1e3)
             obs.TRACER.record_span("ps.reconnect", t_fault_ns,
-                                   time.perf_counter_ns(), **wattrs)
+                                   time.perf_counter_ns(), **self._mlabels,
+                                   **wattrs)
 
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
@@ -1047,7 +1094,8 @@ class PSClient:
         # the span covers the work the client actually does per commit
         # (back-pressure + quantize/pack + send); the ack wait is measured
         # separately by ps.commit_latency_ms when the reply is consumed
-        with obs.span("ps.commit", compress=self.compress or "none"):
+        with obs.span("ps.commit", compress=self.compress or "none",
+                      **self._mlabels):
             self._resilient(lambda: self._commit_nowait_once(delta))
 
     def _commit_nowait_once(self, delta: Sequence[np.ndarray]) -> None:
@@ -1065,7 +1113,7 @@ class PSClient:
             while self._has_pending(net.ACTION_WEIGHTS):
                 self._consume_one()
             if t_drain:
-                obs.histogram("ps.pull_stall_ms").observe(
+                obs.histogram("ps.pull_stall_ms", **self._mlabels).observe(
                     (time.perf_counter() - t_drain) * 1e3)
         while self._unacked() >= self.max_inflight:
             self._consume_one()
@@ -1082,15 +1130,15 @@ class PSClient:
             arrays = [np.asarray(d, np.float32) for d in delta]
         codec.pack(action, arrays)
         if telemetry:
-            obs.histogram("ps.serialize_ms").observe(
+            obs.histogram("ps.serialize_ms", **self._mlabels).observe(
                 (time.perf_counter() - t0) * 1e3)
-            obs.counter("ps.commit_bytes").inc(codec.frame_len)
+            obs.counter("ps.commit_bytes", **self._mlabels).inc(codec.frame_len)
         with self._io_lock:
             codec.send_packed(self.sock)
             self._pending.append((net.ACTION_ACK, time.perf_counter()))
             self._last_io = time.monotonic()
         if telemetry:
-            obs.gauge("ps.inflight_depth").set(self._unacked())
+            obs.gauge("ps.inflight_depth", **self._mlabels).set(self._unacked())
 
     def wait_weights(self) -> List[np.ndarray]:
         """Hand out the oldest in-flight pull, consuming replies (and any
@@ -1099,7 +1147,7 @@ class PSClient:
         t0 = time.perf_counter() if telemetry else 0.0
         self._resilient(self._fill_ready_once)
         if telemetry:
-            obs.histogram("ps.pull_stall_ms").observe(
+            obs.histogram("ps.pull_stall_ms", **self._mlabels).observe(
                 (time.perf_counter() - t0) * 1e3)
         return self._ready.popleft()
 
@@ -1118,7 +1166,7 @@ class PSClient:
         self._resilient(self._drain_once)
         self._ready.clear()
         if obs.enabled():
-            obs.gauge("ps.inflight_depth").set(0)
+            obs.gauge("ps.inflight_depth", **self._mlabels).set(0)
 
     def _drain_once(self) -> None:
         while self._pending:
@@ -1153,9 +1201,10 @@ class PSClient:
             if reply != net.ACTION_ACK:
                 raise ConnectionError(f"expected ack, got {reply!r}")
             if obs.enabled():
-                obs.histogram("ps.commit_latency_ms").observe(
+                obs.histogram("ps.commit_latency_ms", **self._mlabels).observe(
                     (time.perf_counter() - t_sent) * 1e3)
-                obs.gauge("ps.inflight_depth").set(self._unacked())
+                obs.gauge("ps.inflight_depth", **self._mlabels).set(
+                    self._unacked())
         else:
             out = self._pull_bufs[self._flip]
             self._flip ^= 1
@@ -1174,12 +1223,12 @@ class PSClient:
             self._last_io = time.monotonic()
             self._ready.append(out)
             if obs.enabled():
-                obs.histogram("ps.pull_latency_ms").observe(
+                obs.histogram("ps.pull_latency_ms", **self._mlabels).observe(
                     (time.perf_counter() - t_sent) * 1e3)
 
     # -- blocking API (control plane + non-pipelined callers) ------------------
     def pull(self) -> List[np.ndarray]:
-        with obs.span("ps.pull"):
+        with obs.span("ps.pull", **self._mlabels):
             self.pull_nowait()
             return self.wait_weights()
 
@@ -1302,6 +1351,321 @@ class InprocPSClient:
         pass  # no connection; the hub's lifecycle belongs to the trainer
 
     def __enter__(self) -> "InprocPSClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- sharded hub (ISSUE 6): stripe the center across N hub shards --------------
+# One hub holding the whole center is a single-socket bandwidth and
+# single-lock ceiling (the "weight-update state" that arXiv:2004.13336
+# partitions across replicas).  The pieces below partition it across N
+# independent hubs — each shard owns a subset of the center's leaves, runs
+# its own lock, listener and commit clock — while the worker side stripes
+# every pull/commit across all shards over per-shard connections reusing
+# the existing pipelined/zero-copy machinery per connection.
+
+
+class ShardPlan:
+    """A deterministic leaf->shard assignment over a fixed template list.
+
+    ``assignments[s]`` is the ASCENDING list of leaf indices shard ``s``
+    owns — ascending so each shard's frame layout preserves template
+    order (the 1-shard plan is exactly ``[[0..n-1]]``, whose frames are
+    byte-identical to the unsharded codec's).  Built by
+    :func:`shard_plan`; both ends of a sharded deployment (trainer
+    workers, standalone ``distkeras-ps --shard-index`` hubs) derive the
+    SAME plan from the same model, so no plan ever travels on the wire."""
+
+    def __init__(self, num_shards: int, assignments: Sequence[Sequence[int]],
+                 shard_bytes: Sequence[int]):
+        self.num_shards = int(num_shards)
+        self.assignments: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(int(i) for i in idxs) for idxs in assignments)
+        self.shard_bytes: Tuple[int, ...] = tuple(int(b) for b in shard_bytes)
+        self.num_leaves = sum(len(idxs) for idxs in self.assignments)
+
+    def split(self, arrays: Sequence[Any]) -> List[List[Any]]:
+        """Stripe a full-order leaf list into per-shard sublists (reference
+        slicing, no copies)."""
+        if len(arrays) != self.num_leaves:
+            raise ValueError(f"got {len(arrays)} leaves, plan covers "
+                             f"{self.num_leaves}")
+        return [[arrays[i] for i in idxs] for idxs in self.assignments]
+
+    def assemble(self, shard_lists: Sequence[Sequence[Any]]) -> List[Any]:
+        """Inverse of :meth:`split`: reassemble per-shard sublists into the
+        full-order leaf list — by reference, so the per-shard landing
+        buffers ARE the result's storage (zero-copy reassembly)."""
+        out: List[Any] = [None] * self.num_leaves
+        for idxs, vals in zip(self.assignments, shard_lists):
+            if len(idxs) != len(vals):
+                raise ValueError(f"shard holds {len(idxs)} leaves, got "
+                                 f"{len(vals)} values")
+            for i, v in zip(idxs, vals):
+                out[i] = v
+        return out
+
+    def __repr__(self) -> str:
+        return (f"ShardPlan(num_shards={self.num_shards}, "
+                f"leaves={self.num_leaves}, "
+                f"shard_bytes={list(self.shard_bytes)})")
+
+
+def shard_plan(templates: Sequence[np.ndarray], num_shards: int) -> ShardPlan:
+    """Deterministic, size-balanced leaf->shard assignment.
+
+    Leaves are taken in a CANONICAL order — bytes descending, then dtype,
+    then shape — and greedily assigned to the currently-smallest shard
+    (lowest shard id on ties): classic LPT scheduling, so the heaviest
+    shard exceeds the lightest by at most one leaf's bytes.  Because the
+    canonical order depends only on each leaf's (nbytes, dtype, shape)
+    identity, the assignment is STABLE under leaf reordering: permuting
+    the template list maps each leaf to the same shard (leaves with fully
+    identical layout are interchangeable — their mutual order falls back
+    to input position, which only ever swaps byte-identical slots).
+
+    ``num_shards=1`` returns the identity plan (all leaves, template
+    order); more shards than leaves is an error — an empty shard would
+    serve zero-tensor frames to no purpose."""
+    n = len(templates)
+    num_shards = int(num_shards)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if num_shards > n:
+        raise ValueError(f"num_shards={num_shards} exceeds the model's "
+                         f"{n} leaves; every shard must own at least one")
+    arrs = [np.asarray(t) for t in templates]
+    if num_shards == 1:
+        return ShardPlan(1, [list(range(n))], [sum(a.nbytes for a in arrs)])
+    order = sorted(range(n),
+                   key=lambda i: (-arrs[i].nbytes, str(arrs[i].dtype),
+                                  arrs[i].shape, i))
+    heap = [(0, s) for s in range(num_shards)]  # (bytes, shard id)
+    heapq.heapify(heap)
+    assignments: List[List[int]] = [[] for _ in range(num_shards)]
+    for i in order:
+        filled, s = heapq.heappop(heap)
+        assignments[s].append(i)
+        heapq.heappush(heap, (filled + arrs[i].nbytes, s))
+    for idxs in assignments:
+        idxs.sort()
+    shard_bytes = [sum(arrs[i].nbytes for i in idxs) for idxs in assignments]
+    return ShardPlan(num_shards, assignments, shard_bytes)
+
+
+class ShardedParameterServer:
+    """Facade over N per-shard hubs: one :class:`SocketParameterServer`
+    subclass (or :class:`~distkeras_tpu.runtime.native.
+    NativeParameterServer`) per shard, each serving its slice of the
+    center on its own port, lock and commit clock.
+
+    ``hub_factory(shard_weights, shard_id)`` builds one UNSTARTED hub per
+    shard — the trainer's algorithm-specific allocator with the shard's
+    weight subset and identity (so per-shard spans/metrics carry the
+    shard label).  The facade owns lifecycle (``start`` is all-or-nothing:
+    a shard that fails to bind tears the others down), reassembles
+    ``get_weights()`` into full template order, and exposes the direct
+    (inproc) transport pair — ``pull_direct`` returns the full center plus
+    a per-shard clock TUPLE, and ``commit_direct`` accepts that tuple (or
+    a plain int, broadcast — the unsharded client's initial 0), so
+    :class:`InprocPSClient` works against the facade unchanged.
+
+    Snapshot/fence semantics: each shard hub snapshots and restores its
+    OWN slice (give each a per-shard ``snapshot_dir`` subdirectory via the
+    factory); on restore every shard arms its own clock fence, so a
+    snapshot set whose shards are one interval apart is still safe —
+    commits against any shard's dead-incarnation clock are clamped at
+    that shard's restore point.  Elastic membership is per shard
+    (connection-scoped); :meth:`live_workers` reports the MIN across
+    shards — a worker counts as fleet-live only while all its shard
+    connections do."""
+
+    def __init__(self, weights: Sequence[np.ndarray], plan: ShardPlan,
+                 hub_factory):
+        if plan.num_leaves != len(weights):
+            raise ValueError(f"plan covers {plan.num_leaves} leaves, model "
+                             f"has {len(weights)}")
+        self.plan = plan
+        self.shards: List[Any] = []
+        for sid, shard_weights in enumerate(plan.split(list(weights))):
+            self.shards.append(hub_factory(shard_weights, sid))
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        started = []
+        try:
+            for hub in self.shards:
+                hub.start()
+                started.append(hub)
+        except BaseException:
+            for hub in started:
+                try:
+                    hub.stop()
+                except Exception:
+                    pass
+            raise
+
+    def stop(self) -> None:
+        for hub in self.shards:
+            hub.stop()
+
+    def kill(self) -> None:
+        """Crash-like teardown of every shard (see
+        ``SocketParameterServer.kill``)."""
+        for hub in self.shards:
+            hub.kill()
+
+    @property
+    def ports(self) -> List[int]:
+        return [hub.port for hub in self.shards]
+
+    @property
+    def port(self) -> int:
+        """Shard 0's port — for code paths that log or display 'the' hub
+        address; striped clients must use :attr:`ports`."""
+        return self.shards[0].port
+
+    @property
+    def num_updates(self) -> int:
+        """Logical commits applied: every striped commit increments every
+        shard once, so the max across shards is the logical count (shards
+        may momentarily differ while a stripe is in flight)."""
+        return max(hub.num_updates for hub in self.shards)
+
+    def live_workers(self) -> int:
+        """Fleet-live workers: the MIN across shards — a worker whose
+        connection to ANY shard has lapsed no longer counts (its commits
+        are only partially landing)."""
+        return min(hub.live_workers() for hub in self.shards)
+
+    def get_weights(self) -> List[np.ndarray]:
+        return self.plan.assemble([hub.get_weights() for hub in self.shards])
+
+    # -- in-process transport (transport="inproc") -----------------------------
+    def pull_direct(self) -> Tuple[List[np.ndarray], Tuple[int, ...]]:
+        """(full center in template order, per-shard clock tuple).  The
+        tuple rides back through the matching :meth:`commit_direct` —
+        opaque to :class:`InprocPSClient`, exactly like the int clock of
+        an unsharded hub."""
+        shard_weights: List[List[np.ndarray]] = []
+        clocks: List[int] = []
+        for hub in self.shards:
+            w, c = hub.pull_direct()
+            shard_weights.append(w)
+            clocks.append(c)
+        return self.plan.assemble(shard_weights), tuple(clocks)
+
+    def commit_direct(self, delta: Sequence[np.ndarray],
+                      last_pull_clock) -> None:
+        parts = self.plan.split(list(delta))
+        if isinstance(last_pull_clock, (tuple, list)):
+            clocks = list(last_pull_clock)
+            if len(clocks) != self.plan.num_shards:
+                raise ValueError(f"clock tuple has {len(clocks)} entries, "
+                                 f"plan has {self.plan.num_shards} shards")
+        else:
+            # a plain int (the inproc client's commit-before-first-pull
+            # default of 0): broadcast to every shard's clock domain
+            clocks = [int(last_pull_clock)] * self.plan.num_shards
+        for hub, part, clock in zip(self.shards, parts, clocks):
+            hub.commit_direct(part, clock)
+
+
+class ShardedPSClient:
+    """Striped worker-side client: the :class:`PSClient` surface over N
+    per-shard connections.
+
+    A pull fans ``pull_nowait`` out to every shard; each shard's reply
+    streams — via the per-connection zero-copy ``FlatFrameCodec`` path —
+    directly into that shard's slice of the double-buffered landing zone
+    (each per-shard client's landing buffers ARE the slice), and
+    :meth:`wait_weights` reassembles the full-order list by reference.
+    Commits stripe the delta the same way, with acks coalesced per shard
+    connection by the underlying pipelined clients.  ``compress="int8"``
+    quantizes per shard with per-leaf residuals — the same per-leaf
+    error-feedback chain as unsharded, so trajectories match.
+
+    Reconnect/heartbeat semantics apply PER SHARD CONNECTION (each shard
+    client carries its own budget and backoff state); after any
+    unrecovered fault the striped client as a whole is desynchronized —
+    single-use, like :class:`PSClient`.  ``addresses`` is one
+    ``(host, port)`` per shard, aligned with ``plan.assignments``."""
+
+    def __init__(self, addresses: Sequence[Tuple[str, int]],
+                 templates: Sequence[np.ndarray], plan: ShardPlan,
+                 timeout: Optional[float] = 60.0,
+                 compress: Optional[str] = None,
+                 max_inflight: int = 2,
+                 max_reconnects: int = 0,
+                 reconnect_backoff: float = 0.1,
+                 reconnect_backoff_max: float = 5.0,
+                 heartbeat_interval: Optional[float] = None,
+                 trace_context: Optional["dtrace.TraceContext"] = None):
+        if len(addresses) != plan.num_shards:
+            raise ValueError(f"got {len(addresses)} shard addresses, plan "
+                             f"has {plan.num_shards} shards")
+        self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
+        if plan.num_leaves != len(self.templates):
+            raise ValueError(f"plan covers {plan.num_leaves} leaves, model "
+                             f"has {len(self.templates)}")
+        self.plan = plan
+        self.compress = compress
+        self.shards: List[PSClient] = []
+        try:
+            for sid, ((host, port), idxs) in enumerate(
+                    zip(addresses, plan.assignments)):
+                self.shards.append(PSClient(
+                    host, port, [self.templates[i] for i in idxs],
+                    timeout=timeout, compress=compress,
+                    max_inflight=max_inflight,
+                    max_reconnects=max_reconnects,
+                    reconnect_backoff=reconnect_backoff,
+                    reconnect_backoff_max=reconnect_backoff_max,
+                    heartbeat_interval=heartbeat_interval,
+                    trace_context=trace_context, shard_id=sid))
+        except BaseException:
+            self.close()
+            raise
+
+    # -- pipelined API ---------------------------------------------------------
+    def pull_nowait(self) -> None:
+        for client in self.shards:
+            client.pull_nowait()
+
+    def wait_weights(self) -> List[np.ndarray]:
+        """Full-order weight list; each leaf aliases its shard client's
+        landing buffer (reused two pulls later — same ownership contract
+        as :meth:`PSClient.wait_weights`)."""
+        return self.plan.assemble([c.wait_weights() for c in self.shards])
+
+    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+        for client, part in zip(self.shards, self.plan.split(list(delta))):
+            client.commit_nowait(part)
+
+    def drain(self) -> None:
+        for client in self.shards:
+            client.drain()
+
+    # -- blocking API ----------------------------------------------------------
+    def pull(self) -> List[np.ndarray]:
+        with obs.span("ps.pull", sharded=self.plan.num_shards):
+            self.pull_nowait()
+            return self.wait_weights()
+
+    def commit(self, delta: Sequence[np.ndarray]) -> None:
+        self.commit_nowait(delta)
+        self.drain()
+
+    def close(self) -> None:
+        for client in self.shards:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ShardedPSClient":
         return self
 
     def __exit__(self, *exc) -> None:

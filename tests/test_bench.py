@@ -78,6 +78,8 @@ def test_decode_bench_runs_tiny_on_cpu():
 
 
 def test_ring_bench_runs_tiny_on_cpu():
+    if not hasattr(__import__("jax"), "shard_map"):
+        pytest.skip("jax.shard_map unavailable (ring attention needs it)")
     leg = bench._bench_ring(256, batch=1, heads=2, head_dim=64, steps=1)
     assert leg["l_local"] == 256
     assert leg["flash_ms"] > 0 and leg["dense_ms"] > 0
@@ -224,6 +226,56 @@ def test_async_acceptance_block_tripwires():
     assert acc2["adag_vs_sync_ok"] is None and acc2["inproc_vs_sync_ok"] is None
     assert acc2["per_window_speedup_ok"] is False  # 500ms > 421.15/5
     assert acc2["final_loss_parity"] is None
+
+
+def test_async_shard_acceptance_block_tripwires():
+    """The ISSUE-6 shard-scaling tripwire: >= 3x aggregate commit
+    throughput at 4 shards vs 1, None-degrading (the PR-3 convention)
+    when either leg is missing or errored."""
+    out = {"1": {"commits_per_sec": 100.0}, "4": {"commits_per_sec": 320.0}}
+    bench._async_shard_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["shard_scaling_target"] == 3.0
+    assert acc["scaling_x_4_vs_1"] == 3.2
+    assert acc["shard_scaling_ok"] is True
+
+    out2 = {"1": {"commits_per_sec": 100.0}, "4": {"commits_per_sec": 250.0}}
+    bench._async_shard_acceptance(out2)
+    assert out2["acceptance"]["shard_scaling_ok"] is False
+
+    # a dead leg degrades to None tripwires, not a KeyError/ZeroDivision
+    out3 = {"1": {"error": "ConnectionError: hub process died"},
+            "4": {"commits_per_sec": 250.0}}
+    bench._async_shard_acceptance(out3)
+    assert out3["acceptance"]["scaling_x_4_vs_1"] is None
+    assert out3["acceptance"]["shard_scaling_ok"] is None
+
+    out4 = {"1": {"commits_per_sec": 0.0}, "4": {"commits_per_sec": 250.0}}
+    bench._async_shard_acceptance(out4)
+    assert out4["acceptance"]["shard_scaling_ok"] is None  # zero denominator
+
+    out5 = {}  # both legs missing entirely
+    bench._async_shard_acceptance(out5)
+    assert out5["acceptance"]["shard_scaling_ok"] is None
+
+
+@pytest.mark.slow  # spawns ~6 processes; the full suite runs it
+def test_async_shard_bench_runs_tiny():
+    """The shard-scaling leg end to end at toy scale: both legs produce
+    throughput figures, the per-shard decomposition covers every shard,
+    and every shard applied every logical commit."""
+    out = bench._bench_async_shards(shard_counts=(1, 2), workers=2,
+                                    leaves=4, leaf_elems=256,
+                                    commits_per_worker=8)
+    for key, shards in (("1", 1), ("2", 2)):
+        leg = out[key]
+        assert leg["commits_per_sec"] > 0
+        assert set(leg["per_shard"]) == {str(s) for s in range(shards)}
+        for sb in leg["per_shard"].values():
+            assert sb["commits"] == leg["logical_commits"]
+            assert sb["wire_mb"] > 0
+    # acceptance needs the 1 and 4 legs; a (1, 2) run degrades to None
+    assert out["acceptance"]["shard_scaling_ok"] is None
 
 
 def test_async_recovery_acceptance_block_tripwires():
