@@ -1721,6 +1721,16 @@ def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256
     - ``worker_restart``: a seeded :class:`WorkerKillPlan` kills one worker
       mid-window; the supervisor (``on_worker_failure="restart"``)
       restarts it from the hub's center.
+    - ``failover`` (issue 7): an external primary with a hot standby
+      (``replica_of``), killed on its commit clock mid-run by a
+      :class:`HubKillPlan`; workers fail over to the standby inside the
+      reconnect budget.  Records ``ps.failover_ms`` time-to-recover, the
+      promoted replica's commit count vs the kill clock (the zero
+      acked-commit-loss check, slack = workers x max_inflight) and
+      final-loss parity vs fault-free.  Cold timing, like ``sever``.
+    - ``snapshot_barrier`` (issue 7): commit throughput on a 4-shard
+      in-process facade with the coordinated snapshot barrier ticking
+      hard vs not at all — the <5% overhead acceptance number.
 
     Each sub-leg is individually fallible (error recorded, not fatal) and
     the acceptance block degrades to ``None`` for any tripwire whose
@@ -1732,7 +1742,8 @@ def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256
     from distkeras_tpu.models.base import Model
     from distkeras_tpu.models.cnn import mnist_cnn_spec
     from distkeras_tpu.runtime.async_trainer import AsyncADAG
-    from distkeras_tpu.runtime.faults import ChaosProxy, Fault, FaultPlan, WorkerKillPlan
+    from distkeras_tpu.runtime.faults import (ChaosProxy, Fault, FaultPlan,
+                                              HubKillPlan, WorkerKillPlan)
     from distkeras_tpu.runtime.launcher import start_parameter_server
 
     spec = mnist_cnn_spec()
@@ -1825,8 +1836,140 @@ def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256
     except Exception as ex:
         out["worker_restart"] = {"error": f"{type(ex).__name__}: {ex}"}
 
+    try:
+        model0 = Model.init(spec, seed=0)
+        primary = start_parameter_server(model0, mode="adag",
+                                         num_workers=workers,
+                                         idle_timeout=None)
+        replica = None
+        # kill mid-run, on the primary's COMMIT clock (same training
+        # progress every run, machine-independent)
+        kill = HubKillPlan(after_commits=workers * windows_per_epoch)
+        try:
+            replica = start_parameter_server(
+                model0, mode="adag", num_workers=workers, idle_timeout=None,
+                replica_of=("127.0.0.1", primary.port))
+            tr4 = AsyncADAG(Model.init(spec, seed=0),
+                            ps_address=("127.0.0.1", primary.port),
+                            ps_failover=("127.0.0.1", replica.port),
+                            max_reconnects=8, reconnect_backoff=0.05,
+                            **kwargs)
+            obs.enable()
+            obs.reset()
+            try:
+                kill.start(primary)
+                t0 = time.perf_counter()
+                tr4.train(ds, shuffle=False)
+                wall = time.perf_counter() - t0
+                snap = obs.snapshot()
+            finally:
+                obs.reset()
+                obs.disable()
+            kill.join()
+            promoted = bool(replica.promoted)
+            fired_at = kill.fired_at_clock
+            promoted_at = replica.promoted_at_clock
+            replica_commits = int(replica.num_updates)
+        finally:
+            kill.cancel()
+            if replica is not None:
+                replica.stop()
+            try:
+                primary.stop()
+            except Exception:
+                pass
+        fo = (snap.get("histograms", {}).get("ps.failover_ms") or {})
+        out["failover"] = {
+            "timing": "cold-wall (includes compile; see docstring)",
+            "wall_s": round(wall, 3),
+            "final_loss": final_loss(tr4),
+            "killed_at_clock": fired_at,
+            # the replica's clock AT promotion: what actually replicated
+            # before the switch (end-of-run num_updates would be inflated
+            # by post-failover commits and prove nothing)
+            "promoted_at_clock": promoted_at,
+            "replica_commits": replica_commits,
+            # applied-but-unacked commits at the kill instant: the honest
+            # slack on the zero-ACKED-loss bound
+            "acked_loss_slack": workers * tr4.max_inflight_commits,
+            "promoted": promoted,
+            "failovers": snap.get("counters", {}).get("ps.failovers", 0.0),
+            "failover_ms": {"count": fo.get("count"), "mean": fo.get("mean"),
+                            "max": fo.get("max")},
+        }
+    except Exception as ex:
+        out["failover"] = {"error": f"{type(ex).__name__}: {ex}"}
+
+    try:
+        out["snapshot_barrier"] = _bench_snapshot_barrier()
+    except Exception as ex:
+        out["snapshot_barrier"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     _async_recovery_acceptance(out)
     return out
+
+
+def _bench_snapshot_barrier(*, shards: int = 4, min_wall_s: float = 1.0,
+                            snapshot_interval: float = 0.05, reps: int = 3):
+    """Commit throughput through a sharded in-process facade with
+    COORDINATED snapshot sets (the commit barrier) vs INDEPENDENT
+    per-shard snapshotters at the same interval — so the measured delta is
+    the barrier's tax alone, not raw snapshot I/O (<5% acceptance
+    target).  Each leg runs until ``min_wall_s`` has elapsed (many
+    snapshot intervals per leg — a leg shorter than one interval measures
+    snapshot-count luck, not cost); median of ``reps``."""
+    import os as _os
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from distkeras_tpu.runtime.parameter_server import (
+        DeltaParameterServer, ShardedParameterServer, shard_plan)
+
+    t = [np.zeros((128, 128), np.float32) for _ in range(2 * shards)]
+    plan = shard_plan(t, shards)
+    delta = [np.ones(a.shape, np.float32) for a in t]
+
+    def one_leg(coordinated: bool) -> float:
+        with tempfile.TemporaryDirectory() as d:
+            if coordinated:
+                def factory(w, sid):
+                    return DeltaParameterServer(w, idle_timeout=None,
+                                                shard_id=sid)
+                ps = ShardedParameterServer(
+                    t, plan, factory, snapshot_dir=d,
+                    snapshot_interval=snapshot_interval)
+            else:
+                def factory(w, sid):
+                    return DeltaParameterServer(
+                        w, idle_timeout=None, shard_id=sid,
+                        snapshot_dir=_os.path.join(d, f"shard-{sid:02d}"),
+                        snapshot_interval=snapshot_interval)
+                ps = ShardedParameterServer(t, plan, factory)
+            ps.start()
+            try:
+                n = 0
+                t0 = time.perf_counter()
+                while True:
+                    ps.commit_direct(delta, 0)
+                    n += 1
+                    elapsed = time.perf_counter() - t0
+                    if elapsed >= min_wall_s:
+                        return n / elapsed
+            finally:
+                ps.kill()
+
+    base = statistics.median(one_leg(False) for _ in range(reps))
+    coord = statistics.median(one_leg(True) for _ in range(reps))
+    return {
+        "shards": shards,
+        "leg_wall_s": min_wall_s,
+        "snapshot_interval_s": snapshot_interval,
+        "per_shard_commits_per_s": round(base, 1),
+        "coordinated_commits_per_s": round(coord, 1),
+        "overhead_pct": round(100.0 * (base - coord) / base, 2),
+    }
 
 
 def _async_recovery_acceptance(out: dict) -> None:
@@ -1849,6 +1992,12 @@ def _async_recovery_acceptance(out: dict) -> None:
 
     sever_diff, sever_tol = parity("sever")
     restart_diff, restart_tol = parity("worker_restart")
+    failover_diff, failover_tol = parity("failover")
+    fo = out.get("failover", {})
+    barrier = out.get("snapshot_barrier", {})
+    barrier_pct = (barrier.get("overhead_pct")
+                   if isinstance(barrier, dict) and "error" not in barrier
+                   else None)
     out["acceptance"] = {
         "sever_recovered_ok": (bool(out["sever"]["faults_fired"] >= 1
                                     and out["sever"]["reconnects"] >= 1)
@@ -1864,6 +2013,25 @@ def _async_recovery_acceptance(out: dict) -> None:
         "restart_loss_tol": restart_tol,
         "restart_loss_parity_ok": (None if restart_diff is None
                                    else bool(restart_diff <= restart_tol)),
+        # issue-7 failover leg: the kill fired, workers failed over, the
+        # standby promoted, and every ACKED commit survived — judged at
+        # PROMOTION time (clock at promotion >= kill clock minus the
+        # honest in-flight slack; post-failover commits can't inflate it)
+        "failover_recovered_ok": (bool(
+            fo["promoted"] and fo["failovers"] >= 1
+            and fo["promoted_at_clock"] is not None
+            and fo["promoted_at_clock"] >= (fo["killed_at_clock"]
+                                            - fo["acked_loss_slack"]))
+            if _ok("failover") else None),
+        "failover_ms_recorded": (bool((fo["failover_ms"]["count"] or 0) >= 1)
+                                 if _ok("failover") else None),
+        "failover_loss_abs_diff": failover_diff,
+        "failover_loss_tol": failover_tol,
+        "failover_loss_parity_ok": (None if failover_diff is None
+                                    else bool(failover_diff <= failover_tol)),
+        "snapshot_barrier_overhead_pct": barrier_pct,
+        "snapshot_barrier_ok": (None if barrier_pct is None
+                                else bool(barrier_pct < 5.0)),
     }
 
 

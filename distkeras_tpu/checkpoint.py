@@ -125,8 +125,14 @@ class Checkpointer:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step:010d}")
 
     # -- save / restore --------------------------------------------------------
-    def save(self, step: int, trees: Dict[str, Any], metadata: Optional[Dict[str, Any]] = None) -> str:
-        """Atomically write checkpoint ``step`` and apply retention."""
+    def save(self, step: int, trees: Dict[str, Any], metadata: Optional[Dict[str, Any]] = None,
+             apply_retention: bool = True) -> str:
+        """Atomically write checkpoint ``step`` and apply retention.
+
+        ``apply_retention=False`` skips the per-directory keep-N prune —
+        for callers that coordinate retention ACROSS several parallel
+        checkpoint directories (a sharded hub's snapshot set must prune
+        every ``shard-NN/`` dir in lockstep, not each on its own save)."""
         final = self._step_dir(step)
         tmp = os.path.join(self.directory, f".tmp-{step:010d}")
         if os.path.exists(tmp):
@@ -146,8 +152,17 @@ class Checkpointer:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        self._apply_retention()
+        if apply_retention:
+            self._apply_retention()
         return final
+
+    def delete_step(self, step: int) -> None:
+        """Remove checkpoint ``step`` if present (idempotent).  Set-level
+        GC across parallel directories deletes one step from EVERY
+        directory before advancing to the next, so an interruption can
+        strand at most the oldest step half-pruned — never a newer step
+        readable in one directory and gone from another."""
+        shutil.rmtree(self._step_dir(step), ignore_errors=True)
 
     def restore(self, templates: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
         """Restore named pytrees at ``step`` (default: latest).  ``templates``

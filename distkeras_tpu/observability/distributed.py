@@ -342,7 +342,15 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
       commit log) -> per-worker staleness attribution and the
       context-coverage ratio;
     - ``ps.reconnect`` spans (worker attr) -> reconnect storms (a worker
-      with ``>= storm_threshold`` reconnects is flagged).
+      with ``>= storm_threshold`` reconnects is flagged);
+    - ``ps.failover`` spans (worker attr, from/to addresses) -> per-worker
+      failover counts plus ``failovers_total`` and mean/max
+      ``failover_ms`` — the hub-HA availability numbers;
+    - ``ps.promote`` spans -> ``promotions`` (which standby hubs took
+      over, at what clock);
+    - ``ps.stripe_lost`` spans (shard + address attrs) -> ``stripes_lost``,
+      so a striped client dying on ONE shard is attributed to that shard's
+      hub instead of reading as a generic connection error.
 
     Sharded-hub runs (spans carry a ``shard`` attr): one LOGICAL commit
     lands as one per-shard span per shard, so per-worker commit counts and
@@ -366,7 +374,7 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             workers[key] = {"windows": 0, "window_ms_sum": 0.0,
                             "window_ms_max": 0.0, "commits": 0,
                             "staleness_sum": 0, "staleness_max": 0,
-                            "reconnects": 0}
+                            "reconnects": 0, "failovers": 0}
         return workers[key]
 
     def shard_bucket(shard: Any) -> Dict[str, Any]:
@@ -380,6 +388,9 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
     shards: Dict[str, Dict[str, Any]] = {}
     commits_total = 0
     commits_with_ctx = 0
+    failover_ms: List[float] = []
+    promotions: List[Dict[str, Any]] = []
+    stripes_lost: List[Dict[str, Any]] = []
     for s in spans:
         attrs = s.get("attrs") or {}
         name = s.get("name")
@@ -413,6 +424,18 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             b["staleness_max"] = max(b["staleness_max"], stale)
         elif name == "ps.reconnect" and "worker" in attrs:
             bucket(attrs["worker"])["reconnects"] += 1
+        elif name == "ps.failover":
+            failover_ms.append(s.get("dur_us", 0) / 1000.0)
+            if "worker" in attrs:
+                bucket(attrs["worker"])["failovers"] += 1
+        elif name == "ps.promote":
+            promotions.append({"clock": attrs.get("clock"),
+                               "reason": attrs.get("reason"),
+                               "shard": attrs.get("shard")})
+        elif name == "ps.stripe_lost":
+            stripes_lost.append({"shard": attrs.get("shard"),
+                                 "address": attrs.get("address"),
+                                 "worker": attrs.get("worker")})
 
     for b in workers.values():
         b["mean_window_ms"] = round(b["window_ms_sum"] / b["windows"], 3) \
@@ -445,6 +468,13 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         "commit_context_coverage": (round(commits_with_ctx / commits_total, 4)
                                     if commits_total else None),
         "reconnect_storms": storms,
+        "failovers_total": len(failover_ms),
+        "failover_ms_mean": (round(sum(failover_ms) / len(failover_ms), 3)
+                             if failover_ms else None),
+        "failover_ms_max": (round(max(failover_ms), 3)
+                            if failover_ms else None),
+        "promotions": promotions,
+        "stripes_lost": stripes_lost,
     }
     if shards:
         report["shards"] = shards

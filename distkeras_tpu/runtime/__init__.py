@@ -20,6 +20,7 @@ from distkeras_tpu.runtime.faults import (  # noqa: F401
     ChaosProxy,
     Fault,
     FaultPlan,
+    HubKillPlan,
     InjectedWorkerFault,
     ShardedChaosProxy,
     WorkerKillPlan,
@@ -45,9 +46,12 @@ from distkeras_tpu.runtime.parameter_server import (  # noqa: F401
     HubSnapshotter,
     InprocPSClient,
     PSClient,
+    ReplicationFeed,
     ShardedParameterServer,
     ShardedPSClient,
     ShardPlan,
+    SnapshotSetCoordinator,
     SocketParameterServer,
+    StripeLostError,
     shard_plan,
 )

@@ -66,6 +66,7 @@ from distkeras_tpu.runtime.parameter_server import (
     PSClient,
     ShardedParameterServer,
     ShardedPSClient,
+    _normalize_failover,
     shard_plan,
 )
 from distkeras_tpu.trainers import Trainer
@@ -106,6 +107,9 @@ class AsyncDistributedTrainer(Trainer):
     def __init__(self, model, num_workers: int = 2, communication_window: int = 5,
                  native_ps: bool = False,
                  ps_address: Optional[Tuple[str, int]] = None,
+                 ps_failover: Optional[Any] = None,
+                 replica_of: Optional[Tuple[str, int]] = None,
+                 replica_sync_timeout: float = 60.0,
                  checkpoint_interval: float = 30.0,
                  on_worker_failure: str = "raise",
                  max_worker_restarts: int = 2,
@@ -188,6 +192,57 @@ class AsyncDistributedTrainer(Trainer):
             self._ps_addresses = addrs
             self.ps_address = (addrs[0] if len(addrs) == 1
                                else tuple(addrs))
+        # hot-standby failover (ISSUE 7): per-shard standby address(es)
+        # every worker client rotates to when its primary stripe dies
+        # inside the reconnect budget.  Unsharded: one (host, port) pair or
+        # a list of pairs; sharded: one entry per shard, aligned with
+        # ps_address (None for shards without a standby)
+        if ps_failover is None:
+            self._ps_failover: Optional[List[List[Tuple[str, int]]]] = None
+        elif self.num_shards == 1:
+            self._ps_failover = [_normalize_failover(ps_failover)]
+        else:
+            fo = list(ps_failover)
+            if fo and isinstance(fo[0], (str, bytes)):
+                # a bare (host, port) pair: its length can coincide with
+                # num_shards (2 shards!) and would otherwise be sliced
+                # into per-shard garbage instead of erroring
+                raise ValueError(
+                    f"ps_failover got a single (host, port) pair but "
+                    f"num_shards={self.num_shards}; sharded failover needs "
+                    f"one entry per shard (None for shards without a "
+                    f"standby)")
+            if len(fo) != self.num_shards:
+                raise ValueError(
+                    f"ps_failover has {len(fo)} entries but "
+                    f"num_shards={self.num_shards}; sharded failover needs "
+                    f"one entry per shard (None for shards without a "
+                    f"standby)")
+            self._ps_failover = [_normalize_failover(e) for e in fo]
+        # replica_of=(host, port): the trainer-owned hub starts as a HOT
+        # STANDBY of that primary (binds, tracks the primary's center,
+        # promotes itself on feed loss or first commit) — the launcher's
+        # --replica-of for in-process deployments.  Python hub only;
+        # single-shard only (per-shard standbys are per-shard daemons)
+        self.replica_of = (None if replica_of is None
+                           else (str(replica_of[0]), int(replica_of[1])))
+        # how long train() waits for the standby hub's first full sync
+        # before refusing to train (see the wait_synced guard below)
+        self.replica_sync_timeout = float(replica_sync_timeout)
+        if self.replica_of is not None:
+            if ps_address is not None:
+                raise ValueError("replica_of configures the trainer-owned "
+                                 "hub; worker-only mode (ps_address) starts "
+                                 "no hub — point ps_failover at the standby "
+                                 "instead")
+            if self.num_shards > 1:
+                raise ValueError("replica_of requires num_shards=1 (a "
+                                 "sharded deployment runs one standby "
+                                 "daemon per shard primary)")
+            if native_ps:
+                raise ValueError("replica_of requires the Python hub "
+                                 "(native_ps=False); see "
+                                 "NativeParameterServer")
         self.checkpoint_interval = float(checkpoint_interval)
         # failure policy (SURVEY §5 "failure detection" — the reference had
         # none; Spark silently re-ran dead executors).  "raise" surfaces the
@@ -259,7 +314,8 @@ class AsyncDistributedTrainer(Trainer):
         (Python or C++) takes; subclass allocators splat this into their
         constructor.  ``shard_id`` tags a sharded hub's telemetry (None on
         the unsharded path — the exact pre-sharding series)."""
-        return {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id}
+        return {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id,
+                "replica_of": self.replica_of}
 
     def _allocate_hub(self, weights: List[np.ndarray],
                       plan) -> Any:
@@ -377,6 +433,29 @@ class AsyncDistributedTrainer(Trainer):
         else:
             ps = self._allocate_hub(flat_f32, plan)
             ps.start()
+            if self.replica_of is not None:
+                # the trainer's hub is a STANDBY taking over a primary's
+                # job: the workers below must not race the asynchronous
+                # full sync — their first commit would promote the hub
+                # over its fresh init weights and silently discard the
+                # primary's state.  Block until the sync landed, and fail
+                # LOUDLY if it never does (an unreachable primary must not
+                # silently degrade into training from seed)
+                if not ps.wait_synced(timeout=self.replica_sync_timeout):
+                    ps.stop()
+                    raise RuntimeError(
+                        f"replica_of={self.replica_of}: no full sync "
+                        f"arrived from the primary within "
+                        f"{self.replica_sync_timeout}s "
+                        f"(replica_sync_timeout) — it is unreachable or "
+                        f"not a Python hub.  Refusing to train from fresh "
+                        f"weights; drop replica_of to do that deliberately")
+                # this trainer IS the deliberate takeover: promote
+                # explicitly (fence at the sync clock, feed severed)
+                # before any worker runs — the commit-time promotion
+                # trigger is for unplanned failovers and refuses commits
+                # while the primary's feed is still live
+                ps.promote(reason="trainer replica_of takeover (synced)")
             addresses = [("127.0.0.1", p)
                          for p in (ps.ports if plan is not None else [ps.port])]
         self.parameter_server = ps
@@ -384,11 +463,15 @@ class AsyncDistributedTrainer(Trainer):
         def control_client(**kw):
             """A fresh blocking client for control-plane reads (center
             snapshots, the worker-only final pull): striped when sharded,
-            the plain PSClient otherwise."""
+            the plain PSClient otherwise.  Carries the run's failover list
+            so a control read mid-failover lands on the standby too."""
             if plan is not None:
-                return ShardedPSClient(addresses, flat0, plan, **kw)
+                return ShardedPSClient(addresses, flat0, plan,
+                                       failover=self._ps_failover, **kw)
             return PSClient(addresses[0][0], addresses[0][1],
-                            templates=flat0, **kw)
+                            templates=flat0,
+                            failover=(self._ps_failover[0]
+                                      if self._ps_failover else ()), **kw)
         # distributed tracing: one job id for every worker this run spawns
         # (explicit trace_context joins multi-host workers under one job).
         # Resolved once here so a restarted worker keeps the job identity.
@@ -470,7 +553,8 @@ class AsyncDistributedTrainer(Trainer):
                                          max_reconnects=self.max_reconnects,
                                          reconnect_backoff=self.reconnect_backoff,
                                          heartbeat_interval=self.heartbeat_interval,
-                                         trace_context=ctx)
+                                         trace_context=ctx,
+                                         failover=self._ps_failover)
             else:
                 client = PSClient(addresses[0][0], addresses[0][1],
                                   templates=flat0,
@@ -479,7 +563,9 @@ class AsyncDistributedTrainer(Trainer):
                                   max_reconnects=self.max_reconnects,
                                   reconnect_backoff=self.reconnect_backoff,
                                   heartbeat_interval=self.heartbeat_interval,
-                                  trace_context=ctx)
+                                  trace_context=ctx,
+                                  failover=(self._ps_failover[0]
+                                            if self._ps_failover else ()))
             pipeline = self.pipeline
             try:
                 shard = dataset.shard(self.num_workers, idx)

@@ -131,15 +131,23 @@ class ChaosProxy:
 
     The proxy counts telemetry-free and allocation-light: frames are
     relayed in bounded chunks (no whole-frame buffering), and an idle
-    proxy holds no locks on the data path."""
+    proxy holds no locks on the data path.
+
+    ``delay_all_s`` holds EVERY frame (both directions) for that long
+    before forwarding — replication-lag injection: front a primary hub's
+    address with it and point the replica's ``replica_of`` at the proxy,
+    and the standby tracks the primary with a measured, constant lag
+    (planned per-frame faults still apply on top)."""
 
     _CHUNK = 1 << 16
 
     def __init__(self, upstream_host: str, upstream_port: int,
                  plan: Optional[FaultPlan] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 delay_all_s: float = 0.0):
         self.upstream = (upstream_host, int(upstream_port))
         self.plan = plan or FaultPlan()
+        self.delay_all_s = float(delay_all_s)
         self.host = host
         self.port = int(port)
         self._listener: Optional[socket.socket] = None
@@ -278,6 +286,8 @@ class ChaosProxy:
                         return
                     if fault.kind == DELAY:
                         time.sleep(fault.delay_s)
+                if self.delay_all_s > 0.0:
+                    time.sleep(self.delay_all_s)
                 dst.sendall(hdr)
                 self._relay(src, dst, n)
                 frame_idx += 1
@@ -325,6 +335,53 @@ class InjectedWorkerFault(RuntimeError):
     """The exception :class:`WorkerKillPlan` kills workers with — a
     distinct type so tests can assert the recorded error is the injected
     one and not an incidental bug."""
+
+
+class HubKillPlan:
+    """Deterministic kill-primary drill (ISSUE 7): crash a hub —
+    ``hub.kill()``, the SIGKILL-equivalent teardown — once it has applied
+    ``after_commits`` commits.  Scheduling on the hub's own commit clock
+    (not wall time) makes the drill replay at the same training progress
+    every run, so failover tests and the bench's failover leg are
+    comparable across machines.
+
+    ``start(hub)`` spawns the watcher; ``fired`` is set once the kill
+    happened, with ``fired_at_clock`` recording the commit count at the
+    trigger — the "last primary-acked clock" bound the replica's center
+    must meet after promotion."""
+
+    def __init__(self, after_commits: int, poll_interval: float = 0.002):
+        self.after_commits = int(after_commits)
+        self.poll_interval = float(poll_interval)
+        self.fired = threading.Event()
+        self.fired_at_clock: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+        self._cancel = threading.Event()
+
+    def start(self, hub) -> "HubKillPlan":
+        def watch():
+            while not self._cancel.is_set():
+                n = hub.num_updates
+                if n >= self.after_commits:
+                    # read the clock BEFORE the kill: everything applied
+                    # up to here was (or is being) acked to some worker
+                    self.fired_at_clock = int(n)
+                    hub.kill()
+                    self.fired.set()
+                    return
+                time.sleep(self.poll_interval)
+
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        """Stop watching without killing (drill teardown on test failure)."""
+        self._cancel.set()
+
+    def join(self, timeout: Optional[float] = 30.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
 
 
 class ShardedChaosProxy:
@@ -389,5 +446,5 @@ class ShardedChaosProxy:
 
 __all__ = [
     "Fault", "FaultPlan", "ChaosProxy", "ShardedChaosProxy", "WorkerKillPlan",
-    "InjectedWorkerFault", "SEVER", "DELAY", "TRUNCATE",
+    "HubKillPlan", "InjectedWorkerFault", "SEVER", "DELAY", "TRUNCATE",
 ]

@@ -101,7 +101,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            snapshot_interval: float = 30.0,
                            restore: bool = False,
                            num_shards: int = 1,
-                           shard_index: Optional[int] = None) -> Any:
+                           shard_index: Optional[int] = None,
+                           replica_of: Optional[Any] = None) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -126,8 +127,20 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     scale-out topology); ``shard_index=None`` starts all shards in this
     process behind a :class:`~distkeras_tpu.runtime.parameter_server.
     ShardedParameterServer` facade (read ``.ports``).  When sharded,
-    ``snapshot_dir`` gets a ``shard-NN`` subdirectory per shard so the
-    per-shard snapshot sets never collide.
+    ``snapshot_dir`` gets a ``shard-NN`` subdirectory per shard; on the
+    facade path the per-shard snapshots are COORDINATED — one commit
+    barrier per set, restored only as a complete clock-consistent set
+    (:class:`~distkeras_tpu.runtime.parameter_server.
+    SnapshotSetCoordinator`) — while one-daemon-per-shard deployments
+    keep independent per-shard snapshots (no cross-process barrier).
+
+    High availability (``replica_of=(host, port)``): start this hub as a
+    HOT STANDBY of the primary at that address — it serves pulls
+    immediately, tracks the primary's applied commits over the
+    replication feed (wire action ``R``), and promotes itself behind the
+    clock fence when the primary dies.  Python hub only; with
+    ``num_shards > 1`` it requires ``shard_index`` (one standby daemon
+    per shard primary, pointed at THAT shard's address).
     """
     from distkeras_tpu.runtime.parameter_server import (
         ShardedParameterServer, shard_plan)
@@ -139,13 +152,23 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     if shard_index is not None and not (0 <= int(shard_index) < num_shards):
         raise ValueError(f"shard_index={shard_index} out of range for "
                          f"num_shards={num_shards}")
+    if replica_of is not None:
+        replica_of = (str(replica_of[0]), int(replica_of[1]))
+        if native:
+            raise ValueError("replica_of requires the Python hub (drop "
+                             "native=True); the wire protocol is identical")
+        if num_shards > 1 and shard_index is None:
+            raise ValueError("replica_of with num_shards > 1 requires "
+                             "shard_index: run one standby daemon per "
+                             "shard, each pointed at its own primary")
 
-    def make_hub(hub_weights, shard_id, hub_port):
-        shard_snap = snapshot_dir
+    def make_hub(hub_weights, shard_id, hub_port, own_snapshots=True):
+        shard_snap = snapshot_dir if own_snapshots else None
         if shard_snap is not None and shard_id is not None:
             shard_snap = os.path.join(shard_snap, f"shard-{shard_id:02d}")
         common = dict(idle_timeout=idle_timeout, snapshot_dir=shard_snap,
-                      snapshot_interval=snapshot_interval, restore=restore,
+                      snapshot_interval=snapshot_interval,
+                      restore=restore if own_snapshots else False,
                       shard_id=shard_id)
         if native:
             from distkeras_tpu.runtime.native import (
@@ -166,7 +189,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                "dynsgd": DynSGDParameterServer}[mode]
         kwargs = ({"num_workers": num_workers, "elastic": elastic}
                   if mode == "adag" else {})
-        return cls(hub_weights, host=host, port=hub_port, **kwargs, **common)
+        return cls(hub_weights, host=host, port=hub_port,
+                   replica_of=replica_of, **kwargs, **common)
 
     if num_shards == 1:
         ps = make_hub(weights, None, port)
@@ -178,10 +202,17 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                           sid, port)
         else:
             # all shards in one process: consecutive ports from --port, or
-            # all-ephemeral when port=0 (a fixed port can only bind once)
+            # all-ephemeral when port=0 (a fixed port can only bind once).
+            # Durability lives in the facade's COORDINATED snapshot sets
+            # (the per-hub dirs stay unset so the two mechanisms never
+            # fight over the same shard-NN directories)
             ps = ShardedParameterServer(
                 weights, plan,
-                lambda w, sid: make_hub(w, sid, port + sid if port else 0))
+                lambda w, sid: make_hub(w, sid, port + sid if port else 0,
+                                        own_snapshots=False),
+                snapshot_dir=snapshot_dir,
+                snapshot_interval=snapshot_interval,
+                restore=restore)
     ps.start()
     return ps
 
@@ -227,6 +258,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="serve ONLY this shard from this process (one "
                              "distkeras-ps per shard); omit to serve every "
                              "shard from one process")
+    parser.add_argument("--replica-of", default=None, metavar="HOST:PORT",
+                        help="start as a hot standby of the primary hub at "
+                             "this address: serve pulls immediately, stream "
+                             "its applied commits, promote on its death "
+                             "(Python hub only; sharded: one standby daemon "
+                             "per shard, paired with --shard-index)")
     args = parser.parse_args(argv)
     if args.restore and not args.snapshot_dir:
         parser.error("--restore requires --snapshot-dir")
@@ -235,6 +272,19 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.save_final and args.shard_index is not None:
         parser.error("--save-final needs the full center; a single-shard "
                      "process only holds its slice")
+    replica_of = None
+    if args.replica_of:
+        if args.native:
+            parser.error("--replica-of requires the Python hub (drop "
+                         "--native); the wire protocol is identical")
+        if args.num_shards > 1 and args.shard_index is None:
+            parser.error("--replica-of with --num-shards > 1 requires "
+                         "--shard-index (one standby daemon per shard)")
+        host_part, _, port_part = args.replica_of.rpartition(":")
+        if not host_part or not port_part.isdigit():
+            parser.error(f"--replica-of expects HOST:PORT, got "
+                         f"{args.replica_of!r}")
+        replica_of = (host_part, int(port_part))
 
     from distkeras_tpu.models.base import Model
 
@@ -249,7 +299,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                                 snapshot_interval=args.snapshot_interval,
                                 restore=args.restore,
                                 num_shards=args.num_shards,
-                                shard_index=args.shard_index)
+                                shard_index=args.shard_index,
+                                replica_of=replica_of)
+    if replica_of is not None:
+        print(f"ps standby (replica of {replica_of[0]}:{replica_of[1]}) "
+              f"listening on {args.host}:{ps.port}", flush=True)
     if args.num_shards > 1 and args.shard_index is None:
         for sid, p in enumerate(ps.ports):
             print(f"ps shard {sid}/{args.num_shards} listening on "

@@ -169,10 +169,22 @@ class NativeParameterServer:
                  snapshot_interval: float = 30.0,
                  snapshot_keep: int = 3,
                  restore: bool = False,
-                 shard_id: Optional[int] = None):
+                 shard_id: Optional[int] = None,
+                 replica_of: Optional[tuple] = None):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native PS unavailable: {build_error()}")
+        if replica_of is not None:
+            # Documented Python-hub-only fallback (ISSUE 7): the C++ hub's
+            # commit log (dk_ps_drain_commits) records clocks and timings
+            # but not delta payloads, so a faithful applied-commit stream
+            # cannot be rebuilt from it.  HA deployments run the Python
+            # hub — same wire protocol, so clients are unaffected.
+            raise NotImplementedError(
+                "hot-standby replication (replica_of) requires the Python "
+                "hub; the C++ hub has no replication feed — run "
+                "SocketParameterServer / distkeras-ps without --native for "
+                "the replica and primary (identical wire protocol)")
         self._lib = lib
         self._templates = [np.array(w, dtype=np.float32) for w in weights]
         sizes = (ctypes.c_int64 * len(self._templates))(*[t.size for t in self._templates])

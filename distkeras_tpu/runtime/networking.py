@@ -79,6 +79,20 @@ ACTION_PING = b"H"  # client heartbeat-on-idle; hub replies with an ack
 # never see it (the PR 3/4 convention: wire bytes of every pre-existing
 # frame are unchanged, new frames are opt-in).
 ACTION_TRACE = b"T"
+# hub-to-hub replication feed (hot-standby HA): a replica hub announces
+# itself to its primary with an R "hello" frame (one 9-byte header blob);
+# the primary replies on the same connection with one R full-sync frame
+# (header + the whole center at one clock) and thereafter streams one R
+# delta frame per APPLIED commit (header + the post-aggregation scaled
+# delta), sent BEFORE the committing worker's ack leaves — see
+# ``encode_repl_header``.  Opt-in like ``T``: no R frame ever moves unless
+# a replica connects, so pre-R peers interoperate byte-identically.
+ACTION_REPL = b"R"
+
+# R-frame header kinds (first blob, 9 bytes big-endian: u64 clock, u8 kind)
+REPL_DELTA = 0  # primary->replica: blobs[1:] = scaled applied delta
+REPL_SYNC = 1   # primary->replica: blobs[1:] = full center at `clock`
+REPL_HELLO = 2  # replica->primary: no tensor blobs; `clock` = replica's clock
 
 
 class ProtocolError(ValueError):
@@ -367,6 +381,40 @@ def decode_time_payload(blobs: Sequence) -> int:
         raise ProtocolError(f"T timestamp blob has {len(raw)} bytes, want 8")
     (t_ns,) = struct.unpack(">Q", raw)
     return t_ns
+
+
+# -- replication feed (action R) ----------------------------------------------
+
+def encode_repl_header(clock: int, kind: int) -> np.ndarray:
+    """The 9-byte R-frame header blob (u64 clock, u8 kind) as a uint8
+    array — blob 0 of every replication frame, sized so the header rides
+    the same fixed-schema :class:`FlatFrameCodec` as the tensor payload."""
+    return np.frombuffer(struct.pack(">QB", int(clock), int(kind)), np.uint8)
+
+
+def decode_repl_header(blob) -> Tuple[int, int]:
+    """Inverse of :func:`encode_repl_header` -> ``(clock, kind)``."""
+    raw = bytes(memoryview(blob))[:9]
+    if len(raw) != 9:
+        raise ProtocolError(f"R header blob has {len(raw)} bytes, want 9")
+    clock, kind = struct.unpack(">QB", raw)
+    return int(clock), int(kind)
+
+
+def encode_repl_hello(clock: int) -> bytes:
+    """The replica->primary handshake payload: an action-``R`` frame whose
+    single blob is the hello header (the replica's current clock rides
+    along for observability; the primary always full-syncs regardless)."""
+    return encode_tensors(ACTION_REPL, [encode_repl_header(clock, REPL_HELLO)])
+
+
+def repl_frame_templates(center: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """The fixed tensor schema of a full R delta/sync frame over ``center``
+    (header blob + one f32 tensor per center leaf) — feed both ends'
+    :class:`FlatFrameCodec` with this so primary sends and replica receives
+    move through preallocated storage."""
+    return [np.zeros(9, np.uint8)] + [np.zeros(c.shape, np.float32)
+                                      for c in center]
 
 
 def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import contextlib
 import heapq
+import os
 import random
 import socket
 import threading
@@ -144,6 +145,149 @@ class HubSnapshotter:
                 warnings.warn(f"final PS snapshot failed: {type(e).__name__}: {e}")
 
 
+class ReplicationFeed:
+    """Primary-side hot-standby stream (ISSUE 7): every APPLIED commit —
+    the post-aggregation scaled delta plus the commit clock — is framed as
+    an opt-in action-``R`` message and written to each attached replica
+    connection BEFORE the committing worker's ack leaves.  A commit the
+    worker saw acknowledged is therefore already in the kernel's send
+    queue toward the replica, which the kernel flushes even if the primary
+    process is SIGKILLed right after — the "replica center >= last
+    primary-acked clock" guarantee the failover drills pin (a dead HOST
+    additionally needs replica acks; out of scope, see ARCHITECTURE.md
+    "High availability").
+
+    Created lazily on the first replica handshake, so a hub nobody
+    replicates pays nothing (``active()`` is one attribute read on the
+    commit path).  ``attach`` full-syncs the new replica (whole center +
+    clock, one R frame) under the publish lock, so the sync and the delta
+    stream can never interleave inconsistently: deltas at or below the
+    sync clock are skipped per connection, later deltas all flow.  Adds
+    commute, so cross-thread publish-order inversions only reorder
+    float additions (same tolerance class as async SGD itself).
+
+    A replica that stops draining stalls commits at most
+    ``REPLICA_SEND_TIMEOUT`` seconds, then is detached (warned + counted)
+    — availability of the primary wins over completeness of a sick
+    replica's feed.
+
+    Telemetry: ``ps_replicas_connected`` gauge, ``ps.replicate_ms`` send
+    latency, ``ps_replication_lag`` gauge (commits applied but not yet
+    streamed at publish time — bounded by construction, measured so an
+    operator sees it), ``ps_replica_disconnects_total``."""
+
+    REPLICA_SEND_TIMEOUT = 30.0
+
+    def __init__(self, hub: "SocketParameterServer"):
+        self.hub = hub
+        self._lock = threading.Lock()  # serializes attach + publish
+        # [socket, conn ordinal, attach-time SYNC clock] per replica.  The
+        # sync clock is IMMUTABLE after attach: it only filters deltas the
+        # full sync already covered.  It must never advance on sends —
+        # concurrent handlers publish out of clock order (apply under the
+        # hub lock, publish under this one), and a moving watermark would
+        # skip (lose) the lower-clock delta behind a higher one
+        self._conns: List[List[Any]] = []
+        self._codec = net.FlatFrameCodec(net.repl_frame_templates(hub.center))
+
+    def active(self) -> bool:
+        # racy read by design (publish re-checks under the lock): the
+        # commit hot path must not take the feed lock when nobody listens
+        return bool(self._conns)
+
+    def _set_gauge(self) -> None:
+        if obs.enabled():
+            obs.gauge("ps_replicas_connected",
+                      **self.hub._mlabels).set(len(self._conns))
+
+    def attach(self, conn: socket.socket, conn_idx: int) -> None:
+        """Handshake a replica connection: full-sync it (center + clock,
+        captured under the hub lock) and register it for the delta
+        stream.  Registration happens BEFORE the center snapshot: a commit
+        applying after the registration sees ``active()`` and publishes
+        (blocking on this lock until the sync is out, then skipped iff the
+        sync already covered it), while a commit applying before it is in
+        the snapshot — snapshotting first instead would let a commit slip
+        into the gap unpublished AND unsynced."""
+        conn.settimeout(self.REPLICA_SEND_TIMEOUT)
+        with self._lock:
+            entry: List[Any] = [conn, conn_idx, -1]
+            self._conns.append(entry)
+            try:
+                with self.hub._lock:
+                    # pack the center STRAIGHT into the sync frame under
+                    # the lock (one memcpy per tensor — the pull handler's
+                    # idiom); the send happens after release so a slow
+                    # replica can't hold the center
+                    clock = self.hub._clock
+                    self._codec.pack(
+                        net.ACTION_REPL,
+                        [net.encode_repl_header(clock, net.REPL_SYNC)]
+                        + list(self.hub.center))
+                self._codec.send_packed(conn)
+            except BaseException:
+                self._conns.remove(entry)
+                raise
+            entry[2] = clock
+        if obs.enabled():
+            obs.counter("ps_replicas_attached_total",
+                        **self.hub._mlabels).inc()
+            self._set_gauge()
+
+    def publish(self, clock: int, scaled: Sequence[np.ndarray]) -> None:
+        """Stream one applied commit to every attached replica; returns
+        once the frame is written (kernel-owned) everywhere — the caller
+        acks its worker only after."""
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        with self._lock:
+            if not self._conns:
+                return
+            packed = False
+            dead = []
+            for entry in self._conns:
+                conn, conn_idx, sync_clock = entry
+                if sync_clock >= clock:
+                    continue  # already covered by this replica's full sync
+                if not packed:
+                    self._codec.pack(
+                        net.ACTION_REPL,
+                        [net.encode_repl_header(clock, net.REPL_DELTA)]
+                        + list(scaled))
+                    packed = True
+                try:
+                    self._codec.send_packed(conn)
+                except (OSError, ValueError) as e:
+                    dead.append((entry, e))
+            for entry, e in dead:
+                self._detach_locked(entry, e)
+        if telemetry:
+            obs.histogram("ps.replicate_ms", **self.hub._mlabels).observe(
+                (time.perf_counter() - t0) * 1e3)
+            # commits the hub applied while this publish waited its turn:
+            # the feed's real-time backlog (clock reads race commits by
+            # design — it is a gauge, not an invariant)
+            obs.gauge("ps_replication_lag", **self.hub._mlabels).set(
+                max(0, self.hub._clock - clock))
+
+    def _detach_locked(self, entry: List[Any], cause: BaseException) -> None:
+        conn, conn_idx, _ = entry
+        self._conns.remove(entry)
+        warnings.warn(f"replica connection {conn_idx} dropped from the "
+                      f"replication feed: {type(cause).__name__}: {cause}")
+        try:
+            conn.close()
+        except OSError:
+            pass
+        with self.hub._conn_lock:
+            if conn in self.hub._conns:
+                self.hub._conns.remove(conn)
+        if obs.enabled():
+            obs.counter("ps_replica_disconnects_total",
+                        **self.hub._mlabels).inc()
+            self._set_gauge()
+
+
 class SocketParameterServer:
     """Hub-and-spoke PS: one handler thread per worker connection, one lock
     around the center variable — the reference's concurrency model
@@ -169,7 +313,10 @@ class SocketParameterServer:
                  snapshot_interval: float = 30.0,
                  snapshot_keep: int = 3,
                  restore: bool = False,
-                 shard_id: Optional[int] = None):
+                 shard_id: Optional[int] = None,
+                 replica_of: Optional[Tuple[str, int]] = None,
+                 replica_feed_retries: int = 3,
+                 replica_feed_backoff: float = 0.2):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
@@ -225,6 +372,31 @@ class SocketParameterServer:
         self._members: Dict[int, float] = {}
         self._member_lock = threading.Lock()
         self._member_seq = 0
+        # hot-standby HA (ISSUE 7).  Primary side: the replication feed is
+        # created lazily when a replica handshakes (action R), so an
+        # unreplicated hub's commit path is byte-for-byte the pre-HA one.
+        # Replica side: replica_of=(host, port) starts this hub in STANDBY
+        # — it binds and serves pulls like any hub (clients can fail over
+        # to it at any time) while a feed thread tracks the primary's
+        # center; it PROMOTES itself (arming the PR-4 clock fence at its
+        # current clock) when the feed is lost past the retry budget, or
+        # immediately when a failed-over worker commits to it
+        self._feed: Optional[ReplicationFeed] = None
+        self._feed_lock = threading.Lock()
+        self.replica_of = (None if replica_of is None
+                           else (str(replica_of[0]), int(replica_of[1])))
+        self.replica_feed_retries = int(replica_feed_retries)
+        self.replica_feed_backoff = float(replica_feed_backoff)
+        self._standby = self.replica_of is not None
+        self.promoted = False
+        # the replica's clock AT promotion — the number the zero
+        # acked-commit-loss bound is checked against (reading num_updates
+        # later is vacuous: post-failover commits inflate it)
+        self.promoted_at_clock: Optional[int] = None
+        self._synced = threading.Event()  # set on the first applied REPL_SYNC
+        self._replica_stop = threading.Event()
+        self._replica_thread: Optional[threading.Thread] = None
+        self._replica_sock: Optional[socket.socket] = None
         self.snapshotter: Optional[HubSnapshotter] = None
         self._restore = bool(restore)
         if restore and snapshot_dir is None:
@@ -263,6 +435,11 @@ class SocketParameterServer:
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+        if self.replica_of is not None:
+            self._replica_stop.clear()
+            self._replica_thread = threading.Thread(target=self._replica_loop,
+                                                    daemon=True)
+            self._replica_thread.start()
         if self.snapshotter is not None:
             self.snapshotter.start()
 
@@ -279,6 +456,15 @@ class SocketParameterServer:
 
     def _shutdown(self, final_snapshot: bool) -> None:
         self._running = False
+        # stop tracking the primary BEFORE severing anything: a teardown
+        # must never race the feed thread into a promotion
+        self._replica_stop.set()
+        sock = self._replica_sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if self.snapshotter is not None:
             # on stop(): final snapshot while the center is still intact
             # (commits may still be landing — snapshot_state copies under
@@ -311,6 +497,9 @@ class SocketParameterServer:
                     pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        if self._replica_thread is not None:
+            self._replica_thread.join(timeout=5)
+            self._replica_thread = None
         for t in self._handlers:
             t.join(timeout=5)
 
@@ -324,10 +513,17 @@ class SocketParameterServer:
         copy, state dict).  The state rides the snapshot's JSON metadata,
         so it must stay JSON-typed."""
         with self._lock:
-            center = [w.copy() for w in self.center]
-            state = {"clock": int(self._clock),
-                     "num_updates": int(self.num_updates)}
-            state.update(self._algo_state())
+            return self._snapshot_state_locked()
+
+    def _snapshot_state_locked(self) -> Tuple[List[np.ndarray], Dict[str, Any]]:
+        """:meth:`snapshot_state` body, caller holds the center lock — the
+        coordinated snapshot barrier holds EVERY shard's lock at once and
+        reads each shard through this, so the N per-shard snapshots are
+        one causal cut (no commit can land anywhere between the reads)."""
+        center = [w.copy() for w in self.center]
+        state = {"clock": int(self._clock),
+                 "num_updates": int(self.num_updates)}
+        state.update(self._algo_state())
         return center, state
 
     def _algo_state(self) -> Dict[str, Any]:
@@ -351,6 +547,190 @@ class SocketParameterServer:
             self._clock = int(state.get("clock", 0))
             self._clock_fence = self._clock
             self.num_updates = int(state.get("num_updates", 0))
+
+    # -- hot standby (replica side) --------------------------------------------
+    def is_standby(self) -> bool:
+        """True while this hub is a replica tracking its primary (not yet
+        promoted): its center is feed-driven and commits will trigger
+        promotion."""
+        return self._standby
+
+    def _standby_commit_gate(self) -> None:
+        """Split-brain guard: a commit arriving while the feed socket is
+        still CONNECTED must not flip the hub — one misdirected worker
+        landing on the standby while the other workers keep committing to
+        the healthy primary would cause permanent divergence.  The commit
+        is refused, and the connected feed socket is severed as a probe: a
+        live primary resyncs and the hub stays standby, a silently dead
+        one (host loss, no FIN) now fails the feed loop's reconnects and
+        promotes within its budget — after which the worker's retried
+        commit (under its own reconnect budget) lands.
+
+        When the feed is already DOWN (``_replica_sock is None`` — the
+        loop observed a loss and is between reconnect attempts) the
+        primary is presumed dead and the gate returns: the caller
+        promotes immediately, fence armed before the commit's staleness
+        is computed, instead of making the failed-over worker wait out
+        ``replica_feed_retries``.  Called with ``_synced`` already
+        checked."""
+        sock = self._replica_sock
+        if sock is None:
+            return  # feed lost: caller promotes (first failed-over commit)
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise net.ProtocolError(
+            "commit into a standby refused (not promoted yet; verifying "
+            "the primary — retry)")
+
+    def wait_synced(self, timeout: Optional[float] = None) -> bool:
+        """Block until this replica has applied its first full sync from
+        the primary (True), or ``timeout`` elapsed (False).  Callers that
+        are about to COMMIT into a freshly-started standby — e.g. a
+        trainer whose own hub is a ``replica_of`` — must wait here first:
+        a commit into an unsynced standby promotes it over its fresh init
+        weights, silently discarding the primary's state."""
+        return self._synced.wait(timeout)
+
+    def promote(self, reason: str = "manual") -> bool:
+        """Promote a standby replica to primary: arm the clock fence at the
+        current (replicated) clock — so pre-failover pull clocks presented
+        after the switch are clamped to the promotion point, exactly the
+        PR-4 restore semantics — and stop applying feed frames forever.
+        Idempotent; returns True if this call performed the promotion."""
+        with self._lock:
+            if not self._standby or self.promoted:
+                return False
+            self.promoted = True
+            self._standby = False
+            self._clock_fence = self._clock
+            clock = self._clock
+            self.promoted_at_clock = clock
+        t0_ns = time.perf_counter_ns()
+        warnings.warn(f"replica hub promoting to primary at clock {clock}: "
+                      f"{reason}")
+        # the feed thread must stop (and never re-apply a late frame —
+        # promoted is checked under the lock before every apply)
+        self._replica_stop.set()
+        sock = self._replica_sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        if obs.enabled():
+            obs.counter("ps_promotions_total", **self._mlabels).inc()
+            obs.TRACER.record_span("ps.promote", t0_ns,
+                                   time.perf_counter_ns(),
+                                   clock=clock, reason=reason,
+                                   **self._shard_attrs)
+        return True
+
+    def _replica_loop(self) -> None:
+        """Track the primary: connect, handshake (action R hello), apply the
+        full sync then every streamed delta under the center lock.  On feed
+        loss, retry within ``replica_feed_retries`` (exponential backoff);
+        once the budget is gone the primary is presumed dead and the
+        replica promotes itself.  A worker commit arriving first wins the
+        promotion race instead (see the commit paths)."""
+        host, port = self.replica_of
+        codec = net.FlatFrameCodec(net.repl_frame_templates(self.center))
+        hdr = np.empty(9, np.uint8)
+        bufs = [np.empty(c.shape, np.float32) for c in self.center]
+        failures = 0
+        warned_unsynced = False
+        while not self._replica_stop.is_set():
+            try:
+                # a short connect timeout: _shutdown cannot interrupt a
+                # thread blocked INSIDE connect (the socket object does
+                # not exist yet), so this bounds how long a stopping
+                # standby's feed thread can outlive it
+                sock = net.connect(host, port, timeout=5.0,
+                                   payload_hint=codec.frame_len)
+                # the connect timeout must NOT linger as a recv timeout:
+                # the feed is silent between commits (no heartbeat), and a
+                # 30 s idle primary would otherwise read as feed loss —
+                # tearing down and FULL-RESYNCING the center in a loop
+                # while both hubs are healthy.  Block indefinitely instead;
+                # a dead primary still surfaces as EOF/RST, teardown wakes
+                # the recv via shutdown(), and a silent host death is
+                # covered by commit-triggered promotion
+                sock.settimeout(None)
+            except OSError:
+                sock = None
+            if sock is not None and self._replica_stop.is_set():
+                # teardown landed while connect was in flight: exit WITHOUT
+                # the hello — a zombie handshake would trigger a spurious
+                # full-center sync on whatever now owns that port
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            if sock is not None:
+                self._replica_sock = sock
+                try:
+                    net.send_frame(sock, net.encode_repl_hello(self._clock))
+                    while not self._replica_stop.is_set():
+                        action = codec.recv_into(sock, [hdr] + bufs)
+                        if action != net.ACTION_REPL:
+                            raise net.ProtocolError(
+                                f"replica feed expected R, got {action!r}")
+                        clock, kind = net.decode_repl_header(hdr)
+                        with self._lock:
+                            if self.promoted:
+                                return  # late frame post-promotion: never lands
+                            if kind == net.REPL_SYNC:
+                                for c, b in zip(self.center, bufs):
+                                    c[...] = b
+                                self._clock = clock
+                                self.num_updates = clock
+                                self._synced.set()
+                            elif kind == net.REPL_DELTA:
+                                for c, b in zip(self.center, bufs):
+                                    c += b
+                                self._clock = max(self._clock, clock)
+                                self.num_updates += 1
+                            else:
+                                raise net.ProtocolError(
+                                    f"unknown replication kind {kind}")
+                        failures = 0  # a live stream resets the loss budget
+                        if obs.enabled():
+                            obs.counter("ps_replica_frames_total",
+                                        **self._mlabels).inc()
+                            obs.gauge("ps_replica_clock",
+                                      **self._mlabels).set(clock)
+                except (OSError, ValueError, ConnectionError):
+                    pass  # feed lost (or teardown severed it): fall through
+                finally:
+                    self._replica_sock = None
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            if self._replica_stop.is_set() or self.promoted:
+                return
+            failures += 1
+            if failures > self.replica_feed_retries:
+                if self._synced.is_set():
+                    self.promote(reason=f"primary {host}:{port} lost "
+                                        f"({failures - 1} reconnect "
+                                        f"attempts exhausted)")
+                    return
+                # never synced: there is nothing to take over — promoting
+                # would serve fresh init weights as if they were the
+                # job's.  Keep retrying (capped backoff) until the primary
+                # appears; operators see one warning, not a storm
+                if not warned_unsynced:
+                    warnings.warn(
+                        f"replica feed to {host}:{port} failing before any "
+                        f"sync arrived; retrying until the primary appears "
+                        f"(a never-synced standby does not promote)")
+                    warned_unsynced = True
+                failures = self.replica_feed_retries  # cap the backoff
+            self._replica_stop.wait(
+                self.replica_feed_backoff * (2.0 ** (failures - 1)))
 
     # -- elastic membership ----------------------------------------------------
     def _member_join(self, token: int) -> None:
@@ -467,6 +847,10 @@ class SocketParameterServer:
         rx = bytearray(self._frame_bytes)
         reply = net.FlatFrameCodec(self.center)
         ack = net.empty_tensor_frame(net.ACTION_ACK)
+        # set when this connection turns out to be a replica handshake: the
+        # socket's ownership moves to the replication feed and this thread
+        # must exit WITHOUT closing it
+        handoff = False
         if self.idle_timeout is not None:
             # per-recv liveness bound: a peer that dies without FIN (host
             # crash, cable pull) no longer parks this handler forever
@@ -498,6 +882,14 @@ class SocketParameterServer:
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
+                    if self._standby and not self._synced.is_set():
+                        # same rule as commits: seed weights must never be
+                        # served as if they were the job's state — a
+                        # failed-over worker's re-pull here would train a
+                        # window on garbage before its commit is refused
+                        raise net.ProtocolError(
+                            "pull from a never-synced standby refused "
+                            "(it holds no job state yet)")
                     with obs.span("ps.handle_pull", conn=conn_idx,
                                   **self._shard_attrs, **ctx_attrs):
                         with self._lock:
@@ -519,6 +911,26 @@ class SocketParameterServer:
                     delta = (self._decode_delta(blobs)
                              if action == net.ACTION_COMMIT
                              else self._decode_qdelta(blobs))
+                    if self._standby:
+                        if not self._synced.is_set():
+                            # no sync ever landed: this standby holds
+                            # fresh init weights, NOT the job's state —
+                            # promoting would silently restart training
+                            # from seed.  Refuse (drops the connection;
+                            # the worker retries under its budget and
+                            # fails LOUDLY if nothing recovers), matching
+                            # the feed-loss path's never-synced rule
+                            raise net.ProtocolError(
+                                "commit into a never-synced standby "
+                                "refused (it has no state to take over)")
+                        self._standby_commit_gate()
+                        # the feed is down too: the primary is presumed
+                        # dead.  Promote NOW (fence armed before this
+                        # commit's staleness is computed) — losing the
+                        # race to the feed-loss detector is fine,
+                        # promote() is idempotent
+                        self.promote(reason="commit received while standby "
+                                            "(worker failed over)")
                     if not joined:
                         # first commit = this peer is a WORKER (pull-only
                         # readers never join): membership drives the
@@ -528,10 +940,28 @@ class SocketParameterServer:
                     with obs.span("ps.handle_commit", conn=conn_idx,
                                   **self._shard_attrs, **ctx_attrs) as sp:
                         with self._lock:
+                            if last_pull_clock < self._clock_fence:
+                                # the fence moved UNDER this live connection
+                                # (a standby promoted after the connection
+                                # was born): re-base, exactly like the
+                                # inproc path — otherwise a commit retried
+                                # without a fresh pull would carry the full
+                                # replicated clock as staleness and DynSGD
+                                # would near-zero it
+                                last_pull_clock = self._clock_fence
+                                if telemetry:
+                                    obs.counter("ps_fenced_commits_total",
+                                                **self._mlabels).inc()
                             staleness = self._clock - last_pull_clock
-                            self.apply_commit(delta, staleness)
+                            scaled = self._apply_commit_locked(delta, staleness)
                             self.num_updates += 1
                             self._clock += 1
+                            commit_clock = self._clock
+                        if scaled is not None:
+                            # stream to the replica(s) BEFORE acking: once
+                            # the worker sees this ack, the commit is at
+                            # least kernel-owned on its way to the standby
+                            self._feed.publish(commit_clock, scaled)
                         net.send_raw_frame(conn, ack)
                         if getattr(sp, "attrs", None) is not None:
                             # the span's attribution payload: the staleness
@@ -572,6 +1002,26 @@ class SocketParameterServer:
                         ctx_attrs = {}
                     net.send_frame(conn, net.encode_time_payload(
                         time.perf_counter_ns()))
+                elif action == net.ACTION_REPL:
+                    # replica handshake: this peer is a hot standby, not a
+                    # worker.  Attach it to the replication feed (full
+                    # sync + delta stream) and hand the socket over — the
+                    # feed owns it from here, this handler thread exits
+                    clock_hdr, kind = net.decode_repl_header(blobs[0])
+                    if kind != net.REPL_HELLO:
+                        raise net.ProtocolError(
+                            f"unexpected replication kind {kind} from a peer "
+                            f"(only hello initiates a feed)")
+                    with self._feed_lock:
+                        if self._feed is None:
+                            self._feed = ReplicationFeed(self)
+                        feed = self._feed
+                    with obs.span("ps.replica_attach", conn=conn_idx,
+                                  replica_clock=clock_hdr,
+                                  **self._shard_attrs):
+                        feed.attach(conn, conn_idx)
+                    handoff = True
+                    return
                 elif action == net.ACTION_PING:
                     # heartbeat-on-idle: proves liveness (resetting the
                     # idle clock above) and keeps a slow-but-alive worker's
@@ -586,15 +1036,16 @@ class SocketParameterServer:
             pass  # worker vanished mid-exchange; reference behavior: drop it
         finally:
             self._member_leave(member_token)
-            try:
-                conn.close()
-            except OSError:
-                pass
-            # forget the socket so stop() never shuts down an unrelated
-            # descriptor that reuses this slot
-            with self._conn_lock:
-                if conn in self._conns:
-                    self._conns.remove(conn)
+            if not handoff:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                # forget the socket so stop() never shuts down an unrelated
+                # descriptor that reuses this slot
+                with self._conn_lock:
+                    if conn in self._conns:
+                        self._conns.remove(conn)
 
     # -- in-process transport (transport="inproc") -----------------------------
     # Co-located workers skip sockets and framing entirely and call the
@@ -608,6 +1059,12 @@ class SocketParameterServer:
         """Snapshot (center copy, clock at snapshot) — the caller passes the
         clock back with its commit, exactly like a socket worker's
         connection state does."""
+        if self._standby and not self._synced.is_set():
+            # same rule as the socket pull path: seed weights must never
+            # be served as if they were the job's state
+            raise RuntimeError(
+                "pull_direct from a never-synced standby refused "
+                "(it holds no job state yet); wait_synced() first")
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
         # the inproc call runs IN the worker's thread, so the committing
@@ -635,6 +1092,18 @@ class SocketParameterServer:
                                  f"center size {c.size}")
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
+        if self._standby:
+            if not self._synced.is_set():
+                # same rule as the socket path: a never-synced standby has
+                # nothing to take over — refuse loudly rather than promote
+                # fresh init weights into "the job's state"
+                raise RuntimeError(
+                    "commit_direct into a never-synced standby refused "
+                    "(it has no state to take over); wait_synced() first")
+            self._standby_commit_gate()
+            # an inproc commit into a standby means its owner considers it
+            # the live hub: promote (fence first, then apply)
+            self.promote(reason="commit_direct while standby")
         # dtype/shape normalization outside the lock (no-op views for the
         # trainers' float32 payloads)
         arrays = [np.asarray(d, np.float32).reshape(c.shape)
@@ -651,9 +1120,14 @@ class SocketParameterServer:
                         obs.counter("ps_fenced_commits_total",
                                     **self._mlabels).inc()
                 staleness = self._clock - last_pull_clock
-                self.apply_commit(arrays, staleness)
+                scaled = self._apply_commit_locked(arrays, staleness)
                 self.num_updates += 1
                 self._clock += 1
+                commit_clock = self._clock
+            if scaled is not None:
+                # the inproc "ack" is this call returning: stream first,
+                # same ordering contract as the socket handler
+                self._feed.publish(commit_clock, scaled)
             if getattr(sp, "attrs", None) is not None:
                 sp.attrs["staleness"] = staleness
         if telemetry:
@@ -668,6 +1142,35 @@ class SocketParameterServer:
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    def commit_scale(self, staleness: int) -> float:  # pragma: no cover
+        """The scalar this hub multiplies a commit by before adding it to
+        the center.  The replication path (``replica_of`` standbys)
+        materializes ``delta * commit_scale`` so the replica applies the
+        exact post-aggregation bytes the primary did; ``apply_commit``
+        stays the non-replicated in-place fast path, and the two must
+        agree.  Subclasses with a scaling rule override both."""
+        raise NotImplementedError
+
+    def _apply_commit_locked(self, delta: Sequence[np.ndarray],
+                             staleness: int) -> Optional[List[np.ndarray]]:
+        """Apply one commit (caller holds the center lock) and return the
+        scaled applied arrays for the replication feed, or ``None`` when no
+        replica is attached — the pre-HA in-place path, bit-identical
+        (``x * float32(1.0)`` is exact, so a replicated primary's center
+        trajectory matches an unreplicated one bit for bit)."""
+        feed = self._feed
+        if feed is None or not feed.active():
+            self.apply_commit(list(delta), staleness)
+            return None
+        scale = np.float32(self.commit_scale(staleness))
+        # materialize OWNED copies: socket deltas are views into the
+        # connection's receive buffer, which the next frame overwrites —
+        # the feed must outlive that
+        scaled = [np.asarray(d, np.float32) * scale for d in delta]
+        for c, s in zip(self.center, scaled):
+            c += s
+        return scaled
+
 
 class DeltaParameterServer(SocketParameterServer):
     """Unscaled delta adds: ``center += delta``.  Reference
@@ -677,6 +1180,9 @@ class DeltaParameterServer(SocketParameterServer):
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:
         for c, d in zip(self.center, delta):
             c += d
+
+    def commit_scale(self, staleness: int) -> float:
+        return 1.0
 
 
 class ADAGParameterServer(SocketParameterServer):
@@ -704,7 +1210,7 @@ class ADAGParameterServer(SocketParameterServer):
     def _algo_state(self) -> Dict[str, Any]:
         return {"num_workers": self.num_workers, "elastic": self.elastic}
 
-    def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:
+    def commit_scale(self, staleness: int) -> float:
         n = self.num_workers
         if self.elastic:
             live = self.live_workers()
@@ -716,7 +1222,10 @@ class ADAGParameterServer(SocketParameterServer):
             # static denominator rather than scaling by 1/1, which would
             # over-apply every inproc delta num_workers-fold
             n = min(live, self.num_workers) if live >= 1 else self.num_workers
-        inv = 1.0 / n
+        return 1.0 / n
+
+    def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:
+        inv = self.commit_scale(staleness)
         for c, d in zip(self.center, delta):
             c += d * inv
 
@@ -726,10 +1235,47 @@ class DynSGDParameterServer(SocketParameterServer):
     staleness = commits applied since this worker's last pull (reference
     ``DynSGDParameterServer.handle_commit``, SURVEY §2.7)."""
 
+    def commit_scale(self, staleness: int) -> float:
+        return 1.0 / (staleness + 1.0)
+
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:
-        inv = 1.0 / (staleness + 1.0)
+        inv = self.commit_scale(staleness)
         for c, d in zip(self.center, delta):
             c += d * inv
+
+
+def _normalize_failover(entry) -> List[Tuple[str, int]]:
+    """One shard's failover spec -> list of (host, port): accepts ``None``
+    (no standby), one ``(host, port)`` pair, or a sequence of pairs.  A
+    bare string (a pair's stray host, or a sliced-up pair) is a caller
+    bug — iterating its characters would fabricate garbage addresses."""
+    if entry is None:
+        return []
+    if isinstance(entry, (str, bytes)):
+        raise ValueError(f"failover entry {entry!r} is a bare string; "
+                         f"pass a (host, port) pair or a list of them")
+    entry = list(entry)
+    if entry and isinstance(entry[0], (str, bytes)):
+        return [(str(entry[0]), int(entry[1]))]
+    return [(str(h), int(p)) for h, p in entry]
+
+
+class StripeLostError(ConnectionError):
+    """One stripe of a sharded PS deployment is gone: the per-shard
+    connection named here exhausted its reconnect/failover budget (or was
+    configured fail-fast) mid fan-out.  Subclasses ``ConnectionError`` so
+    every pre-existing handler still catches it; the shard identity
+    (index + address) rides the exception so an operator knows WHICH hub
+    to look at instead of a generic connection error."""
+
+    def __init__(self, shard_index: int, host: str, port: int,
+                 cause: BaseException):
+        self.shard_index = int(shard_index)
+        self.host = str(host)
+        self.port = int(port)
+        super().__init__(
+            f"PS stripe lost: shard {self.shard_index} at "
+            f"{self.host}:{self.port} ({type(cause).__name__}: {cause})")
 
 
 def _quantize_commit(delta: Sequence[np.ndarray],
@@ -825,7 +1371,8 @@ class PSClient:
                  reconnect_backoff_max: float = 5.0,
                  heartbeat_interval: Optional[float] = None,
                  trace_context: Optional["dtrace.TraceContext"] = None,
-                 shard_id: Optional[int] = None):
+                 shard_id: Optional[int] = None,
+                 failover: Sequence[Tuple[str, int]] = ()):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -856,6 +1403,16 @@ class PSClient:
         # landing buffers bound this queue at two entries
         self._ready: Deque[List[np.ndarray]] = deque()
         self.host, self.port, self.timeout = host, int(port), timeout
+        # failover address list (ISSUE 7): the primary's address first,
+        # then each hot standby.  Reconnect attempts rotate through the
+        # list (retry the current address once, then walk the standbys),
+        # all under the ONE lifetime budget — failing over is just a
+        # reconnect that lands elsewhere, so the backoff/jitter/budget
+        # semantics PR 4 established apply unchanged
+        self._addresses: List[Tuple[str, int]] = (
+            [(str(host), int(port))]
+            + [(str(h), int(p)) for h, p in (failover or ())])
+        self._addr_idx = 0
         self.max_reconnects = int(max_reconnects)
         self.reconnect_backoff = float(reconnect_backoff)
         self.reconnect_backoff_max = float(reconnect_backoff_max)
@@ -876,8 +1433,7 @@ class PSClient:
         self._io_lock = (threading.Lock() if heartbeat_interval is not None
                          else contextlib.nullcontext())
         self._last_io = time.monotonic()
-        self.sock = net.connect(host, port, timeout=timeout,
-                                payload_hint=self._codec.frame_len)
+        self.sock = self._connect_any()
         # distributed tracing (ISSUE #5): this worker's trace context,
         # announced over the wire (action T) so the hub's spans are
         # attributable, with the local->hub clock offset estimated from
@@ -942,6 +1498,25 @@ class PSClient:
     # -- resilience ------------------------------------------------------------
     _RETRYABLE = (ConnectionError, OSError, net.ProtocolError)
 
+    def _connect_any(self) -> socket.socket:
+        """Initial connect: the primary first, then each failover address
+        in order — a worker (re)started AFTER a failover must be able to
+        join the promoted standby without an operator rewriting its
+        config.  Raises the primary's error when every address refuses."""
+        first_err: Optional[BaseException] = None
+        for i, (host, port) in enumerate(self._addresses):
+            try:
+                sock = net.connect(host, port, timeout=self.timeout,
+                                   payload_hint=self._codec.frame_len)
+            except OSError as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            self._addr_idx = i
+            self.host, self.port = host, port
+            return sock
+        raise first_err  # at least one address exists, so this is set
+
     def _heartbeat_loop(self) -> None:
         interval = self.heartbeat_interval
         while not self._hb_stop.wait(interval / 4.0):
@@ -964,9 +1539,20 @@ class PSClient:
                     # (the caller is idle by construction — nothing
                     # pending — so this thread owns the whole round trip;
                     # leaving the ack for the caller would stall the next
-                    # ping behind a reply nobody is consuming)
-                    self.sock.sendall(self._ping_frame)
-                    net.recv_action(self.sock)
+                    # ping behind a reply nobody is consuming).  The round
+                    # trip runs under its OWN short timeout: a ping must
+                    # never hold the io lock for the full data-plane
+                    # timeout, or close()/reconnect would block behind an
+                    # idle-liveness probe for up to a minute
+                    ping_timeout = max(1.0, interval)
+                    if self.timeout is not None:
+                        ping_timeout = min(ping_timeout, self.timeout)
+                    self.sock.settimeout(ping_timeout)
+                    try:
+                        self.sock.sendall(self._ping_frame)
+                        net.recv_action(self.sock)
+                    finally:
+                        self.sock.settimeout(self.timeout)
                     self._last_io = time.monotonic()
                 except (OSError, ValueError):
                     # poison the connection: a ping whose ack timed out may
@@ -974,7 +1560,14 @@ class PSClient:
                     # as its own reply would desync the stream.  Closing
                     # here turns the caller's next op into a clean
                     # ConnectionError/EBADF — which reconnects when a
-                    # budget is configured
+                    # budget is configured.  NOTE the whole ping (and this
+                    # close) runs under the io lock, and _reconnect swaps
+                    # the socket under the SAME lock with _last_io reset:
+                    # a ping can never fire into a half-swapped socket,
+                    # and a swap can never be poisoned by a stale ping —
+                    # so a heartbeat racing a reconnect costs the caller
+                    # ZERO budget beyond the real fault
+                    # (tests/test_ha.py pins this)
                     try:
                         self.sock.close()
                     except OSError:
@@ -1003,6 +1596,7 @@ class PSClient:
         exhausted."""
         t_fault = time.perf_counter()
         t_fault_ns = time.perf_counter_ns()
+        addr_at_fault = (self.host, self.port)
         # the ENTIRE teardown/backoff/redial runs under the io lock: the
         # heartbeat thread must neither ping a socket mid-replacement nor
         # close (its failure path) the freshly reconnected one — and with
@@ -1022,16 +1616,24 @@ class PSClient:
                     raise ConnectionError(
                         f"PS connection to {self.host}:{self.port} lost and the "
                         f"reconnect budget ({self.max_reconnects}) is exhausted"
+                        + (f" across {len(self._addresses)} failover addresses"
+                           if len(self._addresses) > 1 else "")
                     ) from cause
                 self.reconnects_used += 1
                 nominal = min(self.reconnect_backoff
                               * (2.0 ** (self.reconnects_used - 1)),
                               self.reconnect_backoff_max)
                 time.sleep(nominal * (0.5 + 0.5 * self._jitter.random()))
+                # address rotation: the current address gets one retry,
+                # then attempts walk the failover list — a dead primary's
+                # refused connect fails fast, so the standby is reached
+                # on the very next budgeted attempt
+                host, port = self._addresses[self._addr_idx]
                 try:
-                    self.sock = net.connect(self.host, self.port,
+                    self.sock = net.connect(host, port,
                                             timeout=self.timeout,
                                             payload_hint=self._codec.frame_len)
+                    self.host, self.port = host, port
                     # re-announce the trace context on the fresh
                     # connection (a restarted hub has no memory of the
                     # old one) and refresh the clock-offset estimate
@@ -1051,10 +1653,13 @@ class PSClient:
                     break
                 except (OSError, net.ProtocolError):
                     # hub still down (or died again mid-re-pull/announce):
-                    # drop any entries from the half-reconnected socket
-                    # and back off further on the next attempt
+                    # drop any entries from the half-reconnected socket,
+                    # rotate to the next address and back off further
                     self._pending.clear()
+                    self._addr_idx = ((self._addr_idx + 1)
+                                      % len(self._addresses))
                     continue
+        failed_over = (self.host, self.port) != addr_at_fault
         if obs.enabled():
             # labelled by announced worker identity when tracing is on, so
             # fleet_report can attribute reconnect storms to a worker
@@ -1066,6 +1671,22 @@ class PSClient:
             obs.TRACER.record_span("ps.reconnect", t_fault_ns,
                                    time.perf_counter_ns(), **self._mlabels,
                                    **wattrs)
+            if failed_over:
+                # the reconnect landed on a different (standby) address:
+                # record the fault-to-recovered failover time — the
+                # availability number the kill-primary drills pin
+                obs.counter("ps.failovers", **self._mlabels).inc()
+                obs.histogram("ps.failover_ms", **self._mlabels).observe(
+                    (time.perf_counter() - t_fault) * 1e3)
+                obs.TRACER.record_span(
+                    "ps.failover", t_fault_ns, time.perf_counter_ns(),
+                    from_addr=f"{addr_at_fault[0]}:{addr_at_fault[1]}",
+                    to_addr=f"{self.host}:{self.port}",
+                    **self._mlabels, **wattrs)
+        if failed_over:
+            warnings.warn(f"PS client failed over from "
+                          f"{addr_at_fault[0]}:{addr_at_fault[1]} to "
+                          f"{self.host}:{self.port}")
 
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
@@ -1237,19 +1858,27 @@ class PSClient:
         self.drain()
 
     def close(self) -> None:
-        self._closed = True
         self._hb_stop.set()
-        if self._hb_thread is not None:
-            self._hb_thread.join(timeout=5)
-        try:
-            net.send_raw_frame(self.sock, net.empty_tensor_frame(net.ACTION_BYE))
-        except OSError:
-            pass
-        finally:
+        # the BYE + close runs under the io lock: without it, a heartbeat
+        # mid-ping (which owns the socket for its bounded round trip)
+        # could interleave with the farewell frame, or poison-close a
+        # socket close() is still writing to.  The bounded ping timeout
+        # above caps how long this can wait
+        with self._io_lock:
+            self._closed = True
             try:
-                self.sock.close()
+                net.send_raw_frame(self.sock,
+                                   net.empty_tensor_frame(net.ACTION_BYE))
             except OSError:
                 pass
+            finally:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
 
     def __enter__(self) -> "PSClient":
         return self
@@ -1455,6 +2084,197 @@ def shard_plan(templates: Sequence[np.ndarray], num_shards: int) -> ShardPlan:
     return ShardPlan(num_shards, assignments, shard_bytes)
 
 
+class SnapshotSetCoordinator:
+    """Fleet-consistent snapshot sets for a sharded hub (ISSUE 7).
+
+    PR 6 left each shard hub snapshotting independently — a multi-shard
+    restore could therefore resurrect a TORN center (shard 0 at clock
+    1000, shard 1 at clock 400: a parameter vector no training state ever
+    was).  This coordinator replaces the per-shard snapshotters when all
+    shards live in one process: each tick briefly FENCES commits across
+    every shard (all shard center locks held at once — safe because no
+    commit path ever holds two shard locks) and reads all N shard states
+    inside that barrier, so the N per-shard snapshots share one causal
+    cut.  Native shard hubs keep their own internal atomicity per shard;
+    the cross-shard cut is then only as tight as the read loop, but the
+    recorded clock vector still makes a torn restore detectable.
+
+    Every shard's snapshot is stamped with the SAME step number, a shared
+    ``snapshot_set`` id and the full per-shard ``set_clocks`` vector;
+    :meth:`restore_latest_set` restores only a step that is present,
+    readable, same-set and clock-consistent on EVERY shard — falling back
+    to the newest COMPLETE set when the newest is torn, and raising when
+    sets exist but none survives the checks.
+
+    Retention is set-level: saves skip the per-directory keep-N prune and
+    the coordinator deletes each doomed step from EVERY ``shard-NN/``
+    directory before advancing to the next, oldest first — a crash
+    between prunes can strand at most the oldest step half-deleted, never
+    leave step K readable on shard 0 but pruned on shard 1.
+
+    Telemetry: ``ps.snapshot_set_ms`` (whole save), ``ps.snapshot_fence_ms``
+    (how long commits were fenced — the barrier's cost),
+    ``ps_snapshot_sets_total``."""
+
+    def __init__(self, hubs: Sequence[Any], directory: str,
+                 interval: float = 30.0, keep: int = 3):
+        from distkeras_tpu.checkpoint import Checkpointer
+
+        self.hubs = list(hubs)
+        self.directory = directory
+        self.interval = float(interval)
+        self.keep = int(keep)
+        self.checkpointers = [
+            Checkpointer(os.path.join(directory, f"shard-{sid:02d}"),
+                         keep=keep)
+            for sid in range(len(self.hubs))]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._save_lock = threading.Lock()
+        self._next_step = 1 + max(
+            (cp.latest_step() or 0) for cp in self.checkpointers)
+
+    # -- the causal cut --------------------------------------------------------
+    def _cut(self) -> List[Tuple[List[np.ndarray], Dict[str, Any]]]:
+        locks = [getattr(hub, "_lock", None) for hub in self.hubs]
+        if all(lk is not None for lk in locks):
+            # Python hubs: a true barrier — every shard's center lock held
+            # at once (commit handlers take exactly one shard lock, so no
+            # ordering cycle exists), states read inside
+            t0 = time.perf_counter()
+            with contextlib.ExitStack() as stack:
+                for lk in locks:
+                    stack.enter_context(lk)
+                states = [hub._snapshot_state_locked() for hub in self.hubs]
+            if obs.enabled():
+                obs.histogram("ps.snapshot_fence_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+            return states
+        # native hubs lock in C++: per-shard snapshots are atomic, the
+        # cross-shard cut is best-effort (documented); torn restores are
+        # still detected via the recorded clock vector
+        return [hub.snapshot_state() for hub in self.hubs]
+
+    def save_set(self) -> None:
+        """Write one coordinated snapshot set (all shards, one step, one
+        causal cut), then advance set-level retention."""
+        with self._save_lock, obs.span("ps.snapshot_set"):
+            t0 = time.perf_counter()
+            step = self._next_step
+            set_id = f"set-{step:010d}-{random.getrandbits(32):08x}"
+            states = self._cut()
+            clocks = [int(state["clock"]) for _, state in states]
+            for sid, (cp, (center, state)) in enumerate(
+                    zip(self.checkpointers, states)):
+                cp.save(step, {"center": center},
+                        metadata={"kind": "ps-hub-snapshot", **state,
+                                  "snapshot_set": set_id,
+                                  "set_clocks": clocks,
+                                  "shard_id": sid,
+                                  "num_shards": len(self.hubs)},
+                        apply_retention=False)
+            self._next_step = step + 1
+            self._prune(step)
+            if obs.enabled():
+                obs.histogram("ps.snapshot_set_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+                obs.counter("ps_snapshot_sets_total").inc()
+
+    def _prune(self, latest_step: int) -> None:
+        doomed = sorted({s for cp in self.checkpointers
+                         for s in cp.all_steps()
+                         if s <= latest_step - self.keep})
+        for step in doomed:  # oldest first, each step from EVERY shard
+            for cp in self.checkpointers:
+                cp.delete_step(step)
+
+    def restore_latest_set(self) -> bool:
+        """Restore the newest COMPLETE, same-set, clock-consistent snapshot
+        set into the hubs (each shard re-arms its clock fence via
+        ``restore_state``).  Returns False on a genuinely empty directory
+        (first boot); raises when sets exist but every candidate is torn
+        or unreadable — silently serving fresh weights would discard the
+        job."""
+        per_shard = [set(cp.all_steps()) for cp in self.checkpointers]
+        if not any(per_shard):
+            return False
+        candidates = sorted(set().union(*per_shard), reverse=True)
+        for step in candidates:
+            if not all(step in steps for steps in per_shard):
+                missing = [sid for sid, steps in enumerate(per_shard)
+                           if step not in steps]
+                warnings.warn(f"snapshot step {step} missing on shard(s) "
+                              f"{missing}: torn set, falling back older")
+                continue
+            try:
+                metas = [cp.metadata(step=step)["metadata"]
+                         for cp in self.checkpointers]
+                set_ids = {m.get("snapshot_set") for m in metas}
+                if set_ids == {None}:
+                    # pre-coordination (PR 6) per-shard snapshots: every
+                    # shard wrote independently, so there is no set id or
+                    # clock vector to check.  Still restorable — each
+                    # shard's fence keeps clocks safe — but the cut is
+                    # uncoordinated: say so instead of stranding the job
+                    warnings.warn(
+                        f"snapshot step {step} predates coordinated sets "
+                        f"(no snapshot_set id): restoring per-shard "
+                        f"snapshots whose center may be torn by up to one "
+                        f"snapshot interval across shards (the pre-HA "
+                        f"contract)")
+                elif len(set_ids) != 1 or None in set_ids:
+                    raise ValueError(f"mismatched snapshot_set ids "
+                                     f"{sorted(map(str, set_ids))}")
+                else:
+                    for sid, m in enumerate(metas):
+                        vec = m.get("set_clocks")
+                        if vec is None or \
+                                int(m.get("clock", -1)) != int(vec[sid]):
+                            raise ValueError(
+                                f"shard {sid} clock {m.get('clock')} does "
+                                f"not match the set's recorded vector {vec}")
+                trees = [cp.restore({"center": hub.get_weights()}, step=step)
+                         for cp, hub in zip(self.checkpointers, self.hubs)]
+            except Exception as e:
+                warnings.warn(f"skipping torn/unreadable snapshot set at "
+                              f"step {step}: {type(e).__name__}: {e}")
+                continue
+            for hub, tree, m in zip(self.hubs, trees, metas):
+                hub.restore_state(tree["center"], m)
+            self._next_step = max(self._next_step, step + 1)
+            return True
+        raise RuntimeError(
+            f"restore requested: snapshot sets exist under {self.directory} "
+            f"but none is complete and clock-consistent across all "
+            f"{len(self.hubs)} shards (see warnings)")
+
+    # -- lifecycle -------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.save_set()
+            except Exception as e:  # a full disk must not kill the hubs
+                warnings.warn(f"coordinated PS snapshot failed: "
+                              f"{type(e).__name__}: {e}")
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, final_snapshot: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if final_snapshot:
+            try:
+                self.save_set()
+            except Exception as e:
+                warnings.warn(f"final coordinated PS snapshot failed: "
+                              f"{type(e).__name__}: {e}")
+
+
 class ShardedParameterServer:
     """Facade over N per-shard hubs: one :class:`SocketParameterServer`
     subclass (or :class:`~distkeras_tpu.runtime.native.
@@ -1483,7 +2303,11 @@ class ShardedParameterServer:
     connections do."""
 
     def __init__(self, weights: Sequence[np.ndarray], plan: ShardPlan,
-                 hub_factory):
+                 hub_factory,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_interval: float = 30.0,
+                 snapshot_keep: int = 3,
+                 restore: bool = False):
         if plan.num_leaves != len(weights):
             raise ValueError(f"plan covers {plan.num_leaves} leaves, model "
                              f"has {len(weights)}")
@@ -1491,9 +2315,29 @@ class ShardedParameterServer:
         self.shards: List[Any] = []
         for sid, shard_weights in enumerate(plan.split(list(weights))):
             self.shards.append(hub_factory(shard_weights, sid))
+        # coordinated snapshot sets (ISSUE 7): when the facade owns the
+        # durability story, the N per-shard snapshots are taken inside one
+        # commit barrier and restored only as a complete, clock-consistent
+        # set.  (Per-shard snapshotters built by hub_factory remain the
+        # multi-process --shard-index topology's independent fallback —
+        # don't configure both.)
+        self.coordinator: Optional[SnapshotSetCoordinator] = None
+        self._restore = bool(restore)
+        if restore and snapshot_dir is None:
+            raise ValueError("restore=True requires snapshot_dir")
+        if snapshot_dir is not None:
+            self.coordinator = SnapshotSetCoordinator(
+                self.shards, snapshot_dir, interval=snapshot_interval,
+                keep=snapshot_keep)
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
+        if self.coordinator is not None and self._restore:
+            # load BEFORE any shard binds: the first striped pull must
+            # observe the restored (fenced) set everywhere
+            if not self.coordinator.restore_latest_set():
+                warnings.warn("restore requested but no snapshot set "
+                              "exists yet; serving initial weights")
         started = []
         try:
             for hub in self.shards:
@@ -1506,14 +2350,21 @@ class ShardedParameterServer:
                 except Exception:
                     pass
             raise
+        if self.coordinator is not None:
+            self.coordinator.start()
 
     def stop(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.stop(final_snapshot=True)
         for hub in self.shards:
             hub.stop()
 
     def kill(self) -> None:
         """Crash-like teardown of every shard (see
-        ``SocketParameterServer.kill``)."""
+        ``SocketParameterServer.kill``): no final snapshot set — recovery
+        must come from the last periodic one."""
+        if self.coordinator is not None:
+            self.coordinator.stop(final_snapshot=False)
         for hub in self.shards:
             hub.kill()
 
@@ -1588,10 +2439,15 @@ class ShardedPSClient:
     error-feedback chain as unsharded, so trajectories match.
 
     Reconnect/heartbeat semantics apply PER SHARD CONNECTION (each shard
-    client carries its own budget and backoff state); after any
+    client carries its own budget and backoff state); a stripe whose
+    budget runs out mid fan-out surfaces as :class:`StripeLostError`
+    naming the shard (index + host:port) and emits a ``ps.stripe_lost``
+    span so ``fleet_report`` can attribute the loss.  After any
     unrecovered fault the striped client as a whole is desynchronized —
     single-use, like :class:`PSClient`.  ``addresses`` is one
-    ``(host, port)`` per shard, aligned with ``plan.assignments``."""
+    ``(host, port)`` per shard, aligned with ``plan.assignments``;
+    ``failover`` (optional) is one standby ``(host, port)`` — or a
+    sequence of them — per shard, same alignment."""
 
     def __init__(self, addresses: Sequence[Tuple[str, int]],
                  templates: Sequence[np.ndarray], plan: ShardPlan,
@@ -1602,10 +2458,15 @@ class ShardedPSClient:
                  reconnect_backoff: float = 0.1,
                  reconnect_backoff_max: float = 5.0,
                  heartbeat_interval: Optional[float] = None,
-                 trace_context: Optional["dtrace.TraceContext"] = None):
+                 trace_context: Optional["dtrace.TraceContext"] = None,
+                 failover: Optional[Sequence[Any]] = None):
         if len(addresses) != plan.num_shards:
             raise ValueError(f"got {len(addresses)} shard addresses, plan "
                              f"has {plan.num_shards} shards")
+        if failover is not None and len(failover) != plan.num_shards:
+            raise ValueError(f"got {len(failover)} failover entries, plan "
+                             f"has {plan.num_shards} shards (pass None for "
+                             f"shards without a standby)")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
         if plan.num_leaves != len(self.templates):
             raise ValueError(f"plan covers {plan.num_leaves} leaves, model "
@@ -1624,29 +2485,59 @@ class ShardedPSClient:
                     reconnect_backoff=reconnect_backoff,
                     reconnect_backoff_max=reconnect_backoff_max,
                     heartbeat_interval=heartbeat_interval,
-                    trace_context=trace_context, shard_id=sid))
+                    trace_context=trace_context, shard_id=sid,
+                    failover=_normalize_failover(
+                        failover[sid] if failover is not None else None)))
         except BaseException:
             self.close()
             raise
 
+    def _stripe(self, sid: int, op):
+        """Run one shard client's op, converting an unrecovered connection
+        fault into the typed :class:`StripeLostError` naming the stripe
+        (and recording the ``ps.stripe_lost`` span).  Catches the full
+        retryable set (``PSClient._RETRYABLE``): with ``max_reconnects=0``
+        the ORIGINAL fault propagates — a wedged hub surfaces as
+        ``socket.timeout`` (an OSError that is not a ConnectionError) and
+        a desynced stream as ``ProtocolError`` (a ValueError), and both
+        are stripe deaths every bit as much as a reset is."""
+        try:
+            return op()
+        except StripeLostError:
+            raise  # already typed (nested striped clients don't exist, but)
+        except PSClient._RETRYABLE as e:
+            client = self.shards[sid]
+            if obs.enabled():
+                t_ns = time.perf_counter_ns()
+                wattrs = (client.trace_context.span_attrs()
+                          if client.trace_context is not None else {})
+                obs.counter("ps_stripe_losses_total", shard=str(sid)).inc()
+                obs.TRACER.record_span(
+                    "ps.stripe_lost", t_ns, t_ns, shard=sid,
+                    address=f"{client.host}:{client.port}", **wattrs)
+            raise StripeLostError(sid, client.host, client.port, e) from e
+
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
-        for client in self.shards:
-            client.pull_nowait()
+        for sid, client in enumerate(self.shards):
+            self._stripe(sid, client.pull_nowait)
 
     def wait_weights(self) -> List[np.ndarray]:
         """Full-order weight list; each leaf aliases its shard client's
         landing buffer (reused two pulls later — same ownership contract
         as :meth:`PSClient.wait_weights`)."""
-        return self.plan.assemble([c.wait_weights() for c in self.shards])
+        return self.plan.assemble(
+            [self._stripe(sid, c.wait_weights)
+             for sid, c in enumerate(self.shards)])
 
     def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
-        for client, part in zip(self.shards, self.plan.split(list(delta))):
-            client.commit_nowait(part)
+        for sid, (client, part) in enumerate(
+                zip(self.shards, self.plan.split(list(delta)))):
+            self._stripe(sid, lambda c=client, p=part: c.commit_nowait(p))
 
     def drain(self) -> None:
-        for client in self.shards:
-            client.drain()
+        for sid, client in enumerate(self.shards):
+            self._stripe(sid, client.drain)
 
     # -- blocking API ----------------------------------------------------------
     def pull(self) -> List[np.ndarray]:

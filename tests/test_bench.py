@@ -313,6 +313,55 @@ def test_async_recovery_acceptance_block_tripwires():
     assert acc2["sever_loss_parity_ok"] is None
     assert acc2["worker_restart_ok"] is True
     assert acc2["restart_loss_parity_ok"] is None
+    # legs absent entirely (issue-7 failover + barrier): None, not a crash
+    assert acc2["failover_recovered_ok"] is None
+    assert acc2["failover_ms_recorded"] is None
+    assert acc2["failover_loss_parity_ok"] is None
+    assert acc2["snapshot_barrier_ok"] is None
+
+
+def test_failover_acceptance_block_tripwires():
+    """The issue-7 failover/barrier tripwires: recovered means the kill
+    fired, workers failed over, the standby promoted and its clock AT
+    PROMOTION respects the zero-ACKED-loss bound (kill clock minus the
+    in-flight slack — end-of-run counts are inflated by post-failover
+    commits and prove nothing); the barrier tripwire pins <5%
+    commit-throughput overhead.  All None-degrading."""
+    out = {
+        "fault_free": {"wall_s": 10.0, "final_loss": 2.0},
+        "sever": {"error": "skipped"},
+        "worker_restart": {"error": "skipped"},
+        "failover": {"wall_s": 15.0, "final_loss": 2.08,
+                     "killed_at_clock": 16, "promoted_at_clock": 14,
+                     "replica_commits": 40,
+                     "acked_loss_slack": 4, "promoted": True,
+                     "failovers": 2.0,
+                     "failover_ms": {"count": 2, "mean": 180.0, "max": 300.0}},
+        "snapshot_barrier": {"overhead_pct": 2.4},
+    }
+    bench._async_recovery_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["failover_recovered_ok"] is True
+    assert acc["failover_ms_recorded"] is True
+    assert acc["failover_loss_abs_diff"] == 0.08
+    assert acc["failover_loss_parity_ok"] is True
+    assert acc["snapshot_barrier_overhead_pct"] == 2.4
+    assert acc["snapshot_barrier_ok"] is True
+
+    # acked-commit loss beyond the in-flight slack flips recovered to
+    # False — judged at PROMOTION time, so a post-failover-inflated
+    # replica_commits (40 here) cannot mask it
+    out["failover"]["promoted_at_clock"] = 11
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["failover_recovered_ok"] is False
+    # a heavy barrier flips its tripwire
+    out["snapshot_barrier"] = {"overhead_pct": 9.0}
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["snapshot_barrier_ok"] is False
+    # an errored barrier leg degrades, never crashes
+    out["snapshot_barrier"] = {"error": "OSError: disk full"}
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["snapshot_barrier_ok"] is None
 
 
 def test_observability_acceptance_block_tripwires():
