@@ -2167,6 +2167,128 @@ def _observability_acceptance(out: dict) -> None:
     }
 
 
+def _bench_health(*, workers: int = 2, window: int = 8, batch: int = 256,
+                  windows_per_epoch: int = 8, epochs: int = 3,
+                  reps: int = 3, health_interval_s: float = 0.25):
+    """Issue-8 fleet-health leg: what does the LIVE health plane COST with
+    everything on, and does it actually see the fleet?
+
+    Same warmed AsyncADAG / python-hub / pipelined-socket config as
+    ``_bench_observability``, timed twice:
+
+    - ``health_off``: telemetry disabled, no tracking, no reports — the
+      zero-cost-when-off contract's reference wall.
+    - ``health_on``: registry + spans enabled, the trainer's window
+      instruments opted into sliding-window time series (``obs.track``),
+      workers streaming periodic reports to the hub (wire action ``M``)
+      where the rolling detectors run — the WHOLE plane.
+
+    ``overhead_pct`` is the median-of-``reps`` relative wall cost — the
+    <3% acceptance tripwire.  The on-leg also records what the plane saw:
+    per-worker collector coverage, reports ingested, tracked series, and
+    any ringed events (a healthy 2-worker run should fire none)."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.observability import health as _health
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import mnist_cnn_spec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+
+    spec = mnist_cnn_spec()
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    ds = Dataset({
+        "features": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+        "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)],
+    })
+    tr = AsyncADAG(Model.init(spec, seed=0),
+                   loss="categorical_crossentropy", batch_size=batch,
+                   num_epoch=epochs, learning_rate=0.01, seed=0,
+                   num_workers=workers, communication_window=window)
+    tr.train(ds, shuffle=False)  # compile + warm
+
+    tracked = ("async_window_wall_seconds", "async_windows_total",
+               "ps_commit_staleness")
+
+    def timed(on: bool):
+        walls = []
+        for _ in range(reps):
+            tr.model = Model.init(spec, seed=0)
+            tr.history = []
+            if on:
+                obs.enable()
+                obs.reset()
+                _health.reset_default()
+                for name in tracked:
+                    obs.track(name)
+                tr.health_interval_s = float(health_interval_s)
+            else:
+                # fully off even under an exported DKT_TELEMETRY=1 —
+                # otherwise overhead_pct compares on vs on and reads ~0
+                obs.disable()
+                tr.health_interval_s = None
+            t0 = time.perf_counter()
+            tr.train(ds, shuffle=False)
+            walls.append(time.perf_counter() - t0)
+            if on:
+                obs.disable()
+        return float(np.median(walls))
+
+    was_enabled = obs.enabled()
+    out = {"workers": workers, "window": window, "batch": batch,
+           "epochs": epochs, "reps": reps,
+           "health_interval_s": health_interval_s, "timing": "wall-median"}
+    try:
+        wall_off = timed(False)
+        out["health_off"] = {"wall_s": round(wall_off, 3)}
+        wall_on = timed(True)
+        out["health_on"] = {"wall_s": round(wall_on, 3)}
+        out["overhead_pct"] = round((wall_on / wall_off - 1.0) * 100.0, 2)
+        # evidence from the LAST on-rep (reset_default ran per rep, so
+        # this is one run's view, not reps stacked)
+        fleet = _health.collector().snapshot()
+        seen = fleet.get("workers") or {}
+        out["collector"] = {
+            "workers_seen": len(seen),
+            "reports_ingested": sum((e.get("meta") or {}).get("reports", 0)
+                                    for e in seen.values()),
+            "tracked_series": len(obs.tracked_snapshot()),
+            "events": len(_health.monitor().events()),
+        }
+    finally:
+        for name in tracked:
+            obs.untrack(name)
+        _health.reset_default()
+        if was_enabled:
+            obs.enable()
+    _health_acceptance(out)
+    return out
+
+
+def _health_acceptance(out: dict) -> None:
+    """Attach the issue-8 tripwires, in place: the fully-on health plane
+    (tracking + streaming collector + detectors) under the 3% wall
+    overhead target, and the collector actually covering the fleet (every
+    worker reported at least once).  Booleans, or None when a leg is
+    missing/errored (graceful degradation, the PR-3 convention)."""
+    overhead = out.get("overhead_pct")
+    col = out.get("collector") if isinstance(out.get("collector"), dict) else {}
+    seen = col.get("workers_seen")
+    reports = col.get("reports_ingested")
+    workers = out.get("workers")
+    out["acceptance"] = {
+        "overhead_pct": overhead,
+        "overhead_pct_target": 3.0,
+        "overhead_ok": None if overhead is None else bool(overhead < 3.0),
+        "workers_seen": seen,
+        "fleet_covered": (None if seen is None or workers is None
+                          else bool(seen >= workers)),
+        "reports_ok": None if reports is None else bool(reports > 0),
+    }
+
+
 def _leg_ratio(current: float, base: float):
     """current/base rounded, or None when either side is missing/zero."""
     if not current or not base:
@@ -2397,6 +2519,11 @@ def main() -> None:
                 out["observability"] = _bench_observability()
             except Exception as e:
                 out["observability"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["health"] = _bench_health()
+            except Exception as e:
+                out["health"] = {"error": f"{type(e).__name__}: {e}"}
             _apply_leg_baselines(out, baseline)
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,

@@ -47,6 +47,7 @@ from distkeras_tpu.observability.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    TimeSeries,
 )
 from distkeras_tpu.observability.sinks import JsonlFlusher
 from distkeras_tpu.observability.tracing import SpanTracer
@@ -56,9 +57,10 @@ TRACER = SpanTracer(enabled=False)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "SpanTracer", "JsonlFlusher", "REGISTRY", "TRACER",
+    "TimeSeries", "SpanTracer", "JsonlFlusher", "REGISTRY", "TRACER",
     "enable", "disable", "enabled", "counter", "gauge", "histogram", "span",
     "snapshot", "chrome_trace", "render_prometheus", "reset",
+    "track", "untrack", "series", "tracked_snapshot",
 ]
 
 
@@ -93,6 +95,27 @@ def span(name: str, **attrs):
     return TRACER.span(name, **attrs)
 
 
+def track(name: str, window_s: float = 60.0, max_samples: int = 512) -> None:
+    """Opt a metric name into sliding-window time series (ISSUE 8): every
+    mutation of that instrument also lands one ``(monotonic_ts, value)``
+    sample in an attached :class:`TimeSeries`, read back with
+    :func:`series`/:func:`tracked_snapshot`.  Near-zero for untracked
+    names (one ``is None`` branch per mutation)."""
+    REGISTRY.track(name, window_s=window_s, max_samples=max_samples)
+
+
+def untrack(name: str) -> None:
+    REGISTRY.untrack(name)
+
+
+def series(name: str, **labels: str):
+    return REGISTRY.series(name, **labels)
+
+
+def tracked_snapshot():
+    return REGISTRY.tracked_snapshot()
+
+
 def snapshot():
     return REGISTRY.snapshot()
 
@@ -121,6 +144,13 @@ _DISTRIBUTED_EXPORTS = (
     "fleet_report",
 )
 
+# the fleet health plane (ISSUE 8), same lazy pattern: obs.HealthCollector,
+# obs.health_snapshot() etc. resolve on first touch
+_HEALTH_EXPORTS = (
+    "HealthCollector", "HealthEvent", "HealthMonitor", "health_snapshot",
+    "render_top",
+)
+
 
 def __getattr__(name: str):
     if name == "distributed" or name in _DISTRIBUTED_EXPORTS:
@@ -133,6 +163,13 @@ def __getattr__(name: str):
             "distkeras_tpu.observability.distributed")
         globals()["distributed"] = distributed
         return distributed if name == "distributed" else getattr(distributed, name)
+    if name == "health" or name in _HEALTH_EXPORTS:
+        import importlib
+
+        health = importlib.import_module(
+            "distkeras_tpu.observability.health")
+        globals()["health"] = health
+        return health if name == "health" else getattr(health, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

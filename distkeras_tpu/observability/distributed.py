@@ -327,7 +327,8 @@ def _span_records(events: Optional[Iterable[Dict[str, Any]]],
 
 def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
                  trace_dir: Optional[str] = None,
-                 storm_threshold: int = 3) -> Dict[str, Any]:
+                 storm_threshold: int = 3,
+                 live: Optional[Any] = None) -> Dict[str, Any]:
     """Join hub commit records with worker window spans into one
     per-worker attribution table.
 
@@ -361,11 +362,24 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
     mean commit-handler time so a slow shard is as nameable as a slow
     worker.
 
+    Live mode (ISSUE 8): pass ``live=`` a
+    :class:`~distkeras_tpu.observability.health.HealthCollector` and the
+    report additionally carries its sliding-window snapshot under
+    ``live`` — per-worker rolling rates/means the span join cannot see
+    mid-run — and the ``coverage`` verdict accounts for it.
+
+    Every report carries a ``coverage`` field saying explicitly WHY it is
+    empty or partial (``status``: ``"empty"`` | ``"partial"`` | ``"ok"``
+    plus human-readable ``reasons``): a zero-span trace dir, commits with
+    no announced worker contexts, workers with window spans but no commit
+    records, or a live collector whose series are too short for rates all
+    name themselves instead of relying on join luck.
+
     Returns a JSON-safe dict: ``workers`` (per-worker stats),
     ``stragglers`` (worker ids, slowest first), ``top_straggler``,
-    ``commit_context_coverage``, ``reconnect_storms``, and — when any
-    span names a shard — ``shards``, ``shards_ranked`` and
-    ``slowest_shard``."""
+    ``commit_context_coverage``, ``reconnect_storms``, ``coverage``,
+    optionally ``live``, and — when any span names a shard — ``shards``,
+    ``shards_ranked`` and ``slowest_shard``."""
     spans = _span_records(events, trace_dir)
 
     def bucket(worker: Any) -> Dict[str, Any]:
@@ -386,6 +400,7 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
 
     workers: Dict[str, Dict[str, Any]] = {}
     shards: Dict[str, Dict[str, Any]] = {}
+    window_spans = 0
     commits_total = 0
     commits_with_ctx = 0
     failover_ms: List[float] = []
@@ -395,6 +410,7 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         attrs = s.get("attrs") or {}
         name = s.get("name")
         if name == "async.window" and "worker" in attrs:
+            window_spans += 1
             b = bucket(attrs["worker"])
             ms = s.get("dur_us", 0) / 1000.0
             b["windows"] += 1
@@ -480,4 +496,80 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         report["shards"] = shards
         report["shards_ranked"] = shards_ranked
         report["slowest_shard"] = shards_ranked[0] if shards_ranked else None
+    live_snap = None
+    if live is not None:
+        try:
+            live_snap = live.snapshot()
+        except Exception:
+            live_snap = None  # a half-built collector degrades to span-only
+        if live_snap is not None:
+            report["live"] = live_snap
+    report["coverage"] = _report_coverage(
+        len(spans), window_spans, commits_total, commits_with_ctx,
+        workers, live_snap)
     return report
+
+
+def _report_coverage(n_spans: int, window_spans: int, commits_total: int,
+                     commits_with_ctx: int,
+                     workers: Dict[str, Dict[str, Any]],
+                     live_snap: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The explicit why-is-this-empty/partial verdict every
+    :func:`fleet_report` carries (ISSUE 8 satellite): each way the join
+    can silently come up short names itself as a reason instead of the
+    caller inferring it from missing keys."""
+    reasons: List[str] = []
+    if n_spans == 0:
+        reasons.append("no spans: telemetry disabled, empty trace dir, or "
+                       "nothing recorded yet")
+    else:
+        if window_spans == 0:
+            reasons.append("no async.window spans: worker window timings "
+                           "missing, straggler ranking is empty")
+        if commits_total == 0:
+            reasons.append("no ps.handle_commit spans: hub commit records "
+                           "missing, staleness attribution is empty")
+        elif commits_with_ctx == 0:
+            reasons.append("commits carry no worker context: clients never "
+                           "announced trace contexts (action T) — a join "
+                           "miss, not an absence of commits")
+        orphans = sorted(w for w, b in workers.items()
+                         if b["windows"] and not b["commits"])
+        if commits_with_ctx and orphans:
+            reasons.append(f"worker(s) {orphans} have window spans but no "
+                           f"attributed commits: their exchanges never "
+                           f"reached this hub's records")
+    live_workers = insufficient = None
+    if live_snap is not None:
+        live = live_snap.get("workers") or {}
+        live_workers = len(live)
+        insufficient = sorted(
+            w for w, e in live.items()
+            if all((m or {}).get("n", 0) < 2
+                   for m in (e.get("metrics") or {}).values()))
+        if not live:
+            # health reporting is opt-in: its absence must not mark a
+            # COMPLETE span join "partial" forever (the punchcard always
+            # passes the collector).  Only when there are no spans either
+            # does the empty collector explain anything — say so then
+            if n_spans == 0:
+                reasons.append("live collector holds no workers: no health "
+                               "report ever arrived (health_interval_s "
+                               "unset, or the run has not started)")
+        elif insufficient:
+            reasons.append(f"live series for worker(s) {insufficient} hold "
+                           f"< 2 samples: rates and baselines not yet "
+                           f"computable")
+    empty = n_spans == 0 and not (live_snap and live_snap.get("workers"))
+    out: Dict[str, Any] = {
+        "status": "empty" if empty else ("partial" if reasons else "ok"),
+        "spans": n_spans,
+        "window_spans": window_spans,
+        "commits": commits_total,
+        "commits_with_context": commits_with_ctx,
+        "reasons": reasons,
+    }
+    if live_snap is not None:
+        out["live_workers"] = live_workers
+        out["live_insufficient"] = insufficient
+    return out

@@ -105,7 +105,15 @@ class JsonlFlusher:
         self._write_lock = threading.Lock()
 
     def flush(self) -> None:
-        line = {"ts": time.time(), "metrics": self.registry.snapshot()}
+        # both clocks on every record (ISSUE 8 satellite): "ts" (wall)
+        # stays for log joins, "ts_monotonic" gives downstream rate/lag
+        # computation an exact dt across flush jitter — the snapshot
+        # itself carries the same pair, captured at ITS read time
+        line = {"ts": time.time(), "ts_monotonic": time.monotonic(),
+                "metrics": self.registry.snapshot()}
+        series = self.registry.tracked_snapshot()
+        if series:
+            line["series"] = series
         if self.tracer is not None:
             spans = self.tracer.drain()
             if spans:

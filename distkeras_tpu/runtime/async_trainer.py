@@ -125,6 +125,7 @@ class AsyncDistributedTrainer(Trainer):
                  elastic: bool = False,
                  ps_idle_timeout: Optional[float] = None,
                  trace_context: Optional[str] = None,
+                 health_interval_s: Optional[float] = None,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -294,6 +295,27 @@ class AsyncDistributedTrainer(Trainer):
         # while telemetry is enabled — with obs off no context exists and
         # no T frame ever leaves (pre-T hubs interoperate)
         self.trace_context = trace_context
+        # live fleet health plane (ISSUE 8): every health_interval_s
+        # seconds each worker pushes one compact metric report (windows,
+        # rolling window wall, reconnect/failover totals) to the hub —
+        # wire action M on the pipelined FIFO (socket) or a direct
+        # collector fold (inproc) — where the online detectors run over
+        # the per-worker sliding windows.  Default None = OFF: no M frame
+        # ever leaves, so pre-M hubs interoperate byte-identically.  The
+        # C++ hub has no M handler: over sockets a report against it is a
+        # connection fault, hence the guard below
+        if health_interval_s is not None:
+            health_interval_s = float(health_interval_s)
+            if health_interval_s <= 0:
+                raise ValueError(f"health_interval_s must be positive, "
+                                 f"got {health_interval_s}")
+            if native_ps and transport == "socket":
+                raise ValueError(
+                    "health_interval_s requires a Python hub over sockets "
+                    "(the C++ hub has no health-report handler); use "
+                    "transport='inproc' (reports fold into the process "
+                    "collector directly) or drop native_ps")
+        self.health_interval_s = health_interval_s
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
@@ -431,6 +453,14 @@ class AsyncDistributedTrainer(Trainer):
             ps = None
             addresses = list(self._ps_addresses)
         else:
+            if self.health_interval_s is not None:
+                # we own the hub, so the process-default collector/monitor
+                # serve THIS run: drop the previous run's series and frozen
+                # throughput baseline, or run 2's ramp-up reads as a
+                # regression against run 1's steady state (remote hubs are
+                # long-lived and multi-job; only the owner resets)
+                from distkeras_tpu.observability import health as _health
+                _health.reset_default()
             ps = self._allocate_hub(flat_f32, plan)
             ps.start()
             if self.replica_of is not None:
@@ -567,6 +597,33 @@ class AsyncDistributedTrainer(Trainer):
                                   failover=(self._ps_failover[0]
                                             if self._ps_failover else ()))
             pipeline = self.pipeline
+            # live health plane (ISSUE 8): periodic compact reports to the
+            # hub's collector.  Wholly inert when off (health_interval is
+            # None -> zero extra calls on the window path)
+            health_interval = self.health_interval_s
+            h_next = time.monotonic() + (health_interval or 0.0)
+            h_seq = 0          # per-worker report sequence number
+            h_windows = 0      # cumulative windows this worker ran
+            h_wall_ms = 0.0    # window wall accumulated since last report
+            h_wall_n = 0
+
+            def send_health() -> None:
+                nonlocal h_seq, h_wall_ms, h_wall_n
+                client.report_health({
+                    "job": trace_job or "local", "worker": idx,
+                    "seq": h_seq, "t_wall": time.time(),
+                    "metrics": {
+                        # *_total = cumulative (the collector's rate()
+                        # convention); window_wall_ms = point sample (the
+                        # mean since the last report)
+                        "windows_total": float(h_windows),
+                        "window_wall_ms": (h_wall_ms / h_wall_n
+                                           if h_wall_n else None),
+                        "reconnects_total": float(client.reconnects_used),
+                        "failovers_total": float(client.failovers_used),
+                    }})
+                h_seq += 1
+                h_wall_ms, h_wall_n = 0.0, 0
             try:
                 shard = dataset.shard(self.num_workers, idx)
                 # worker state lives on the device for the whole run;
@@ -607,7 +664,9 @@ class AsyncDistributedTrainer(Trainer):
                         if self.fault_hook is not None:
                             self.fault_hook(idx, w)
                         telemetry = obs.enabled()
-                        t_wall = time.perf_counter() if telemetry else 0.0
+                        t_wall = (time.perf_counter()
+                                  if telemetry or health_interval is not None
+                                  else 0.0)
                         with obs.span("async.window", worker=idx,
                                       epoch=epoch, window=w):
                             if not pull_pending:
@@ -654,10 +713,21 @@ class AsyncDistributedTrainer(Trainer):
                         if telemetry:
                             m_wall.observe(time.perf_counter() - t_wall)
                             m_windows.inc()
+                        if health_interval is not None:
+                            h_windows += 1
+                            h_wall_ms += (time.perf_counter() - t_wall) * 1e3
+                            h_wall_n += 1
+                            if time.monotonic() >= h_next:
+                                send_health()
+                                h_next = time.monotonic() + health_interval
                         # loss stays a device scalar until the run ends:
                         # float() here would add one more blocking round
                         # trip per window
                         losses.append(mloss)
+                if health_interval is not None:
+                    # final report: a run (or epoch tail) shorter than the
+                    # interval still lands at least one report per worker
+                    send_health()
                 # trailing acks (and nothing else: the last window never
                 # prefetches) — commits must be APPLIED before the run's
                 # final center read, not just queued on the wire

@@ -446,11 +446,25 @@ class Punchcard:
             if req.get("fleet"):
                 # straggler/staleness attribution over this process's span
                 # ring (ISSUE #5) — when a trace directory is configured
-                # the report instead joins EVERY flushed process's spans
+                # the report instead joins EVERY flushed process's spans.
+                # ISSUE 8: the live collector rides along so the report's
+                # coverage reflects streaming health too
+                from distkeras_tpu.observability import health as _health
                 from distkeras_tpu.observability.distributed import fleet_report
 
                 resp["fleet"] = fleet_report(
-                    trace_dir=os.environ.get("DKT_TRACE_DIR") or None)
+                    trace_dir=os.environ.get("DKT_TRACE_DIR") or None,
+                    live=_health.collector())
+            if req.get("health"):
+                # live fleet health (ISSUE 8): this process's collector
+                # (per-worker sliding-window series, fed by wire action M
+                # or direct folds) + the monitor's ringed HealthEvents —
+                # the payload distkeras-top redraws.  Reading runs the
+                # rate-limited detector pass, so polling IS the detection
+                # cadence when no report has triggered one recently
+                from distkeras_tpu.observability import health as _health
+
+                resp["health"] = _health.health_snapshot()
             net.send_json(conn, resp)
         elif action == "shutdown":
             net.send_json(conn, {"ok": True})
@@ -836,11 +850,12 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._request({"action": "status", "job_id": self.job_id})
 
-    def telemetry(self, trace: bool = False, fleet: bool = False) -> Dict[str, Any]:
+    def telemetry(self, trace: bool = False, fleet: bool = False,
+                  health: bool = False) -> Dict[str, Any]:
         """The daemon's live telemetry snapshot (see :func:`fetch_telemetry`);
         daemon-wide, so it does not require this job to be submitted."""
         return fetch_telemetry(self.host, self.port, self.secret, trace=trace,
-                               fleet=fleet)
+                               fleet=fleet, health=health)
 
     def cancel(self) -> str:
         if self.job_id is None:
@@ -892,19 +907,24 @@ def list_jobs(host: str, port: int, secret: str) -> List[Dict[str, Any]]:
 def fetch_telemetry(host: str, port: int, secret: str,
                     trace: bool = False,
                     prometheus: bool = False,
-                    fleet: bool = False) -> Dict[str, Any]:
+                    fleet: bool = False,
+                    health: bool = False) -> Dict[str, Any]:
     """Pull the daemon process's telemetry (authenticated): the metrics
     snapshot, plus the span ring as Chrome ``trace_event`` JSON when
     ``trace=True``, the Prometheus text exposition when
-    ``prometheus=True``, and the distributed-tracing
+    ``prometheus=True``, the distributed-tracing
     :func:`~distkeras_tpu.observability.distributed.fleet_report`
     (straggler ranking, per-worker staleness attribution, reconnect
-    storms) when ``fleet=True``.  Works mid-job — this is how a running
-    job's counters/staleness/window histograms are read remotely."""
+    storms) when ``fleet=True``, and the LIVE fleet health view
+    (per-worker sliding-window series + ringed ``HealthEvent``s from the
+    daemon process's collector/monitor — what ``distkeras-top`` renders)
+    when ``health=True``.  Works mid-job — this is how a running job's
+    counters/staleness/window histograms are read remotely."""
     with _Conn(host, port, secret) as conn:
         return conn.request({"action": "telemetry", "trace": bool(trace),
                              "prometheus": bool(prometheus),
-                             "fleet": bool(fleet)})
+                             "fleet": bool(fleet),
+                             "health": bool(health)})
 
 
 def shutdown(host: str, port: int, secret: str) -> None:

@@ -102,7 +102,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            restore: bool = False,
                            num_shards: int = 1,
                            shard_index: Optional[int] = None,
-                           replica_of: Optional[Any] = None) -> Any:
+                           replica_of: Optional[Any] = None,
+                           health_jsonl: Optional[str] = None) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -141,6 +142,14 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     clock fence when the primary dies.  Python hub only; with
     ``num_shards > 1`` it requires ``shard_index`` (one standby daemon
     per shard primary, pointed at THAT shard's address).
+
+    Live fleet health (ISSUE 8): a Python hub automatically folds worker
+    health reports (wire action ``M``, sent by trainers with
+    ``health_interval_s``) into this process's
+    :mod:`~distkeras_tpu.observability.health` collector and runs the
+    online detectors over them; ``health_jsonl`` additionally appends
+    every :class:`HealthEvent` to that path as JSON lines (durable even
+    if the process dies before anyone polls).
     """
     from distkeras_tpu.runtime.parameter_server import (
         ShardedParameterServer, shard_plan)
@@ -191,6 +200,14 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                   if mode == "adag" else {})
         return cls(hub_weights, host=host, port=hub_port,
                    replica_of=replica_of, **kwargs, **common)
+
+    if health_jsonl is not None:
+        # arm the process monitor's durable sink BEFORE serving: the first
+        # detector firing (possibly triggered by the very first worker
+        # report) must already land on disk
+        from distkeras_tpu.observability import health as _health
+
+        _health.monitor().jsonl_path = str(health_jsonl)
 
     if num_shards == 1:
         ps = make_hub(weights, None, port)
@@ -258,6 +275,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="serve ONLY this shard from this process (one "
                              "distkeras-ps per shard); omit to serve every "
                              "shard from one process")
+    parser.add_argument("--health-jsonl", default=None, metavar="PATH",
+                        help="append every fleet HealthEvent (straggler, "
+                             "staleness spike, reconnect/failover storm, "
+                             "replication lag, throughput regression) to "
+                             "this file as JSON lines; live view: "
+                             "distkeras-top against a punchcard daemon")
     parser.add_argument("--replica-of", default=None, metavar="HOST:PORT",
                         help="start as a hot standby of the primary hub at "
                              "this address: serve pulls immediately, stream "
@@ -300,7 +323,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                                 restore=args.restore,
                                 num_shards=args.num_shards,
                                 shard_index=args.shard_index,
-                                replica_of=replica_of)
+                                replica_of=replica_of,
+                                health_jsonl=args.health_jsonl)
     if replica_of is not None:
         print(f"ps standby (replica of {replica_of[0]}:{replica_of[1]}) "
               f"listening on {args.host}:{ps.port}", flush=True)

@@ -79,6 +79,23 @@ ACTION_PING = b"H"  # client heartbeat-on-idle; hub replies with an ack
 # never see it (the PR 3/4 convention: wire bytes of every pre-existing
 # frame are unchanged, new frames are opt-in).
 ACTION_TRACE = b"T"
+# health-report push (live fleet health plane, ISSUE 8): a worker
+# periodically sends one M frame carrying a single JSON blob — its
+# compact per-worker metric report (windows, rolling window wall,
+# reconnect/failover totals) — which the hub folds into the process
+# HealthCollector and acks (the ack coalesces into later receives like a
+# commit ack, so reports ride the pipelined FIFO instead of their own
+# round trip).  Opt-in like ``T``: no M frame ever moves unless the
+# trainer sets ``health_interval_s``, so pre-M peers interoperate
+# byte-identically.
+ACTION_HEALTH = b"M"
+# receive-bound allowance for control-plane frames (the single-JSON-blob
+# payloads of actions T and M, whose size derives from report contents,
+# not from the model): the hub receives against
+# max(largest tensor frame, CONTROL_PAYLOAD_MAX), so a verbose health
+# report fits even on a tiny center while a garbage length prefix still
+# cannot conjure more than ~64 KiB
+CONTROL_PAYLOAD_MAX = 64 * 1024
 # hub-to-hub replication feed (hot-standby HA): a replica hub announces
 # itself to its primary with an R "hello" frame (one 9-byte header blob);
 # the primary replies on the same connection with one R full-sync frame
@@ -363,6 +380,15 @@ def encode_context_payload(context_json: bytes) -> bytes:
     announcing worker's :class:`~distkeras_tpu.observability.distributed.
     TraceContext`."""
     return encode_tensors(ACTION_TRACE, [np.frombuffer(context_json, np.uint8)])
+
+
+def encode_health_payload(report_json: bytes) -> bytes:
+    """The worker->hub health-report payload (action ``M``): a tensor
+    frame whose single blob is the UTF-8 JSON report the
+    :class:`~distkeras_tpu.observability.health.HealthCollector`
+    ingests."""
+    return encode_tensors(ACTION_HEALTH,
+                          [np.frombuffer(report_json, np.uint8)])
 
 
 def encode_time_payload(t_ns: int) -> bytes:

@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import contextlib
 import heapq
+import itertools
+import json
 import os
 import random
 import socket
@@ -261,14 +263,24 @@ class ReplicationFeed:
                     dead.append((entry, e))
             for entry, e in dead:
                 self._detach_locked(entry, e)
+        # commits the hub applied while this publish waited its turn:
+        # the feed's real-time backlog (clock reads race commits by
+        # design — it is a gauge, not an invariant)
+        lag = max(0, self.hub._clock - clock)
         if telemetry:
             obs.histogram("ps.replicate_ms", **self.hub._mlabels).observe(
                 (time.perf_counter() - t0) * 1e3)
-            # commits the hub applied while this publish waited its turn:
-            # the feed's real-time backlog (clock reads race commits by
-            # design — it is a gauge, not an invariant)
-            obs.gauge("ps_replication_lag", **self.hub._mlabels).set(
-                max(0, self.hub._clock - clock))
+            obs.gauge("ps_replication_lag", **self.hub._mlabels).set(lag)
+        # and into the live collector under the hub's own pseudo-worker
+        # key, so the replication-lag-growth detector sees it as a moving
+        # series.  NOT behind the registry flag: the health plane has its
+        # own opt-in (a worker reporting health activates it), and the
+        # fold self-guards to a few None checks when the plane is off.
+        # any_shard: the KEY carries the shard — every shard's lag is
+        # its own series, and shard N's must not be gated on shard 0
+        self.hub._observe_health(
+            f"hub{'' if self.hub.shard_id is None else self.hub.shard_id}",
+            "replication_lag", lag, any_shard=True)
 
     def _detach_locked(self, entry: List[Any], cause: BaseException) -> None:
         conn, conn_idx, _ = entry
@@ -357,9 +369,12 @@ class SocketParameterServer:
         # the larger of the f32 blob (4*size) and the int8 Q blob
         # (4 + size — bigger for SCALAR leaves).  The handler receives
         # against this bound, so a garbage length prefix is a typed
-        # ProtocolError instead of a 16 GiB bytearray
-        self._max_payload = 5 + sum(8 + max(w.nbytes, 4 + w.size)
-                                    for w in self.center)
+        # ProtocolError instead of a 16 GiB bytearray.  Floored at the
+        # control-frame allowance so a T announce / M health report fits
+        # even when the center is tiny
+        self._max_payload = max(
+            5 + sum(8 + max(w.nbytes, 4 + w.size) for w in self.center),
+            net.CONTROL_PAYLOAD_MAX)
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
         # half-open liveness: a peer that dies without FIN used to park its
         # handler in recv() forever.  With idle_timeout set, a connection
@@ -383,6 +398,12 @@ class SocketParameterServer:
         # immediately when a failed-over worker commits to it
         self._feed: Optional[ReplicationFeed] = None
         self._feed_lock = threading.Lock()
+        # live health plane (ISSUE 8): bound lazily on the FIRST action-M
+        # report — a hub no worker reports to never imports the health
+        # module, and the commit path's only cost is one `is None` check
+        self._health: Optional[Any] = None
+        self._health_monitor: Optional[Any] = None
+        self._health_mod: Optional[Any] = None  # cached module ref (peek path)
         self.replica_of = (None if replica_of is None
                            else (str(replica_of[0]), int(replica_of[1])))
         self.replica_feed_retries = int(replica_feed_retries)
@@ -625,6 +646,21 @@ class SocketParameterServer:
                                    time.perf_counter_ns(),
                                    clock=clock, reason=reason,
                                    **self._shard_attrs)
+        # live health plane (ISSUE 8): a promotion IS a failover event —
+        # record it through the process monitor so distkeras-top / the
+        # punchcard health pull see it DURING the run, naming the promoted
+        # standby.  Promotion is rare (never the hot path) and must not be
+        # taken down by a health-pipeline hiccup
+        try:
+            from distkeras_tpu.observability import health as _health
+
+            _health.monitor().emit(
+                "failover", "critical", shard=self.shard_id,
+                dedup=f"promote:{self.host}:{self.port}",
+                promoted=f"{self.host}:{self.port}", clock=clock,
+                reason=reason)
+        except Exception:
+            pass
         return True
 
     def _replica_loop(self) -> None:
@@ -763,6 +799,59 @@ class SocketParameterServer:
                 return len(self._members)
             return sum(1 for last in self._members.values()
                        if now - last <= self.idle_timeout)
+
+    # -- live health plane (ISSUE 8) -------------------------------------------
+    def _ingest_health(self, report: Dict[str, Any]) -> None:
+        """Fold one worker health report into the process-default
+        :class:`~distkeras_tpu.observability.health.HealthCollector` and
+        give the detectors a (rate-limited) chance to run.  Lazy binding:
+        the health module only loads once a report actually arrives."""
+        # bind collector and monitor INDEPENDENTLY: _observe_health's
+        # any_shard path may have pre-bound _health (joining an active
+        # plane) without a monitor — a combined check would then deref
+        # None on the first wire report and tear down the connection
+        if self._health is None or self._health_monitor is None:
+            from distkeras_tpu.observability import health as _health
+
+            if self._health is None:
+                self._health = _health.collector()
+            if self._health_monitor is None:
+                self._health_monitor = _health.monitor()
+        self._health.ingest(report, shard=self.shard_id)
+        self._health_monitor.maybe_check()
+
+    def _observe_health(self, worker: Any, metric: str, value: float,
+                        any_shard: bool = False) -> None:
+        """Hub-side signal fold (per-commit staleness, replication lag)
+        into the SAME per-worker series the wire reports feed.  By
+        default shard-0 only under a sharded hub — one logical commit
+        lands on every shard, and the fleet view must count it once (the
+        ``fleet_report`` convention); ``any_shard`` is for series whose
+        KEY already carries the shard (the hub's own pseudo-worker)."""
+        if worker is None:
+            return
+        if not any_shard and self.shard_id is not None and self.shard_id != 0:
+            return
+        if self._health is None:
+            if not any_shard:
+                return
+            # wire reports only ever land on shard 0 (and on the facade's
+            # shard-0 route), so a shard-N hub's _ingest_health never runs
+            # — its own pseudo-worker series (replication lag) must join
+            # an ALREADY-active plane here.  active_collector never
+            # creates and is a lock-free global peek; the module ref is
+            # cached on self so the plane-off cost per publish is two
+            # attribute loads and a None check
+            if self._health_mod is None:
+                from distkeras_tpu.observability import health as _health
+
+                self._health_mod = _health
+            bound = self._health_mod.active_collector()
+            if bound is None:
+                return
+            self._health = bound
+        self._health.observe(str(worker), metric, float(value),
+                             shard=self.shard_id)
 
     # -- serving loop (reference: SocketParameterServer.run) -------------------
     def _accept_loop(self) -> None:
@@ -968,6 +1057,11 @@ class SocketParameterServer:
                             # this exact commit applied with (fleet_report
                             # joins it to the announcing worker)
                             sp.attrs["staleness"] = staleness
+                    # live health plane: this commit's staleness joins the
+                    # announcing worker's sliding-window series (no-op —
+                    # one attribute check — until a worker reports health)
+                    self._observe_health(ctx_attrs.get("worker"),
+                                         "staleness", staleness)
                     if telemetry:
                         obs.counter("ps_commits_total", **self._mlabels).inc()
                         obs.counter("ps_commit_bytes_total",
@@ -1022,6 +1116,19 @@ class SocketParameterServer:
                         feed.attach(conn, conn_idx)
                     handoff = True
                     return
+                elif action == net.ACTION_HEALTH:
+                    # worker health report (ISSUE 8): fold into the live
+                    # collector and ack — the ack coalesces into the
+                    # client's later receives exactly like a commit ack,
+                    # so reports ride the pipelined FIFO.  The guard is
+                    # BROAD on purpose: malformed JSON, a broken detector,
+                    # a full-disk JSONL sink — health must never take down
+                    # a training connection (the malformed-T rule)
+                    try:
+                        self._ingest_health(json.loads(bytes(blobs[0])))
+                    except Exception:
+                        pass
+                    net.send_raw_frame(conn, ack)
                 elif action == net.ACTION_PING:
                     # heartbeat-on-idle: proves liveness (resetting the
                     # idle clock above) and keeps a slow-but-alive worker's
@@ -1130,6 +1237,11 @@ class SocketParameterServer:
                 self._feed.publish(commit_clock, scaled)
             if getattr(sp, "attrs", None) is not None:
                 sp.attrs["staleness"] = staleness
+        if self._health is not None:
+            # guarded HERE so the disabled path never even builds the span
+            # attrs dict (the zero-cost-when-off contract)
+            self._observe_health(dtrace.current_span_attrs().get("worker"),
+                                 "staleness", staleness)
         if telemetry:
             obs.counter("ps_commits_total", **self._mlabels).inc()
             obs.histogram("ps_rpc_seconds", rpc="commit.inproc",
@@ -1296,6 +1408,9 @@ def _quantize_commit(delta: Sequence[np.ndarray],
     return blobs
 
 
+_CLIENT_ORDINALS = itertools.count()
+
+
 class PSClient:
     """Worker-side connection: ``pull()`` / ``commit(delta)`` (reference:
     ``NetworkWorker.pull/commit``, SURVEY §2.10) — plus the pipelined
@@ -1384,6 +1499,11 @@ class PSClient:
         self.shard_id = None if shard_id is None else int(shard_id)
         self._mlabels = ({} if shard_id is None
                          else {"shard": str(int(shard_id))})
+        # failover-event dedup key: a process-monotonic ordinal, NOT
+        # id(self) — CPython reuses addresses after GC, and a recycled id
+        # would let a replacement client's failover land inside the dead
+        # client's cooldown and vanish
+        self._client_ordinal = next(_CLIENT_ORDINALS)
         self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
                           if compress else None)
         self._codec = net.FlatFrameCodec(self.templates)
@@ -1417,6 +1537,10 @@ class PSClient:
         self.reconnect_backoff = float(reconnect_backoff)
         self.reconnect_backoff_max = float(reconnect_backoff_max)
         self.reconnects_used = 0
+        # reconnects that LANDED on a different (standby) address — the
+        # cumulative count the worker's health reports carry (ISSUE 8), so
+        # the hub-side failover-storm detector sees it as a moving series
+        self.failovers_used = 0
         # entropy-seeded ON PURPOSE: the jitter exists so a fleet of
         # workers severed by one hub restart does NOT retry in lockstep —
         # a shared deterministic seed would reproduce exactly that herd
@@ -1684,9 +1808,34 @@ class PSClient:
                     to_addr=f"{self.host}:{self.port}",
                     **self._mlabels, **wattrs)
         if failed_over:
+            self.failovers_used += 1
             warnings.warn(f"PS client failed over from "
                           f"{addr_at_fault[0]}:{addr_at_fault[1]} to "
                           f"{self.host}:{self.port}")
+            # live health plane (ISSUE 8): surface the failover as a
+            # HealthEvent in THIS process's monitor immediately — naming
+            # the standby the client landed on — so a co-located
+            # distkeras-top / punchcard health pull sees it during the
+            # run (remote hubs additionally learn of it through the
+            # failovers_total series in the next health report)
+            try:
+                from distkeras_tpu.observability import health as _health
+
+                _health.monitor().emit(
+                    "failover", "critical",
+                    worker=(self.trace_context.worker_id
+                            if self.trace_context is not None else None),
+                    shard=self.shard_id,
+                    # untraced clients carry no worker id: without a
+                    # per-client dedup, every failover of a multi-worker
+                    # fleet in one process would collapse to the first
+                    dedup=f"client:{self._client_ordinal}",
+                    from_addr=f"{addr_at_fault[0]}:{addr_at_fault[1]}",
+                    to_addr=f"{self.host}:{self.port}",
+                    failover_ms=round((time.perf_counter() - t_fault) * 1e3,
+                                      1))
+            except Exception:
+                pass
 
     # -- pipelined API ---------------------------------------------------------
     def pull_nowait(self) -> None:
@@ -1793,6 +1942,32 @@ class PSClient:
         while self._pending:
             self._consume_one()
 
+    # -- live health plane (ISSUE 8) -------------------------------------------
+    def report_health(self, report: Dict[str, Any]) -> None:
+        """Push one compact health report to the hub (wire action ``M``) —
+        the worker half of the streaming collector.  Fire-and-forget on
+        the pipelined FIFO: the hub's ack coalesces into later receives
+        exactly like a commit ack, so a report costs one small send, not a
+        round trip.  Opt-in like the ``T`` announce: a client that never
+        reports sends exactly the pre-``M`` byte stream (and a report sent
+        to a hub that predates action ``M`` surfaces as a connection
+        fault, the documented upgrade contract)."""
+        payload = net.encode_health_payload(
+            json.dumps(report).encode("utf-8"))
+        self._resilient(lambda: self._report_health_once(payload))
+
+    def _report_health_once(self, payload: bytes) -> None:
+        with self._io_lock:
+            # send_frame (not send_raw_frame): encode_health_payload
+            # returns the prefix-less payload, like the T announce.
+            # Pending kind is ACTION_HEALTH, not ACTION_ACK: the hub's
+            # reply frame is the same ack byte, but a health ack must not
+            # land in ps.commit_latency_ms or hold a max_inflight commit
+            # slot (_unacked counts ACTION_ACK entries only)
+            net.send_frame(self.sock, payload)
+            self._pending.append((net.ACTION_HEALTH, time.perf_counter()))
+            self._last_io = time.monotonic()
+
     def _has_pending(self, kind: bytes) -> bool:
         # snapshot under the io lock: the heartbeat thread appends to
         # _pending, and a deque must not be iterated during a mutation
@@ -1816,12 +1991,15 @@ class PSClient:
 
     def _consume_one_inner(self) -> None:
         kind, t_sent = self._pending.popleft()
-        if kind == net.ACTION_ACK:
+        if kind != net.ACTION_WEIGHTS:
+            # ACTION_ACK (commit) and ACTION_HEALTH (report) both await
+            # the same ack byte; only the commit's round trip is a commit
+            # latency sample
             reply = net.recv_action(self.sock)
             self._last_io = time.monotonic()
             if reply != net.ACTION_ACK:
                 raise ConnectionError(f"expected ack, got {reply!r}")
-            if obs.enabled():
+            if kind == net.ACTION_ACK and obs.enabled():
                 obs.histogram("ps.commit_latency_ms", **self._mlabels).observe(
                     (time.perf_counter() - t_sent) * 1e3)
                 obs.gauge("ps.inflight_depth", **self._mlabels).set(
@@ -1926,6 +2104,26 @@ class InprocPSClient:
         self.trace_context = trace_context
         self.clock_offset_ns = 0
         self.clock_error_ns: Optional[int] = 0 if trace_context is not None else None
+        # no connection, so nothing to reconnect or fail over — kept so
+        # the worker loop's health reports read one uniform client surface
+        self.reconnects_used = 0
+        self.failovers_used = 0
+
+    # -- live health plane (ISSUE 8) -------------------------------------------
+    def report_health(self, report: Dict[str, Any]) -> None:
+        """Same contract as :meth:`PSClient.report_health`, minus the wire:
+        the report folds straight into the co-located hub's collector
+        (Python hubs and the sharded facade ingest with their shard
+        labels; a native hub's reports land in the process-default
+        collector directly)."""
+        ingest = getattr(self.ps, "_ingest_health", None)
+        if ingest is not None:
+            ingest(report)
+            return
+        from distkeras_tpu.observability import health as _health
+
+        _health.collector().ingest(report)
+        _health.monitor().maybe_check()
 
     # -- pipelined API (eager) -------------------------------------------------
     def pull_nowait(self) -> None:
@@ -2423,6 +2621,22 @@ class ShardedParameterServer:
         for hub, part, clock in zip(self.shards, parts, clocks):
             hub.commit_direct(part, clock)
 
+    # -- live health plane (ISSUE 8) -------------------------------------------
+    def _ingest_health(self, report: Dict[str, Any]) -> None:
+        """Fold one worker report through shard 0 — mirroring the striped
+        wire path, where reports travel on the shard-0 connection only (one
+        LOGICAL report per worker, the ``fleet_report`` counting rule)."""
+        ingest = getattr(self.shards[0], "_ingest_health", None)
+        if ingest is not None:
+            ingest(report)
+            return
+        # native shard hubs have no Python-side ingest: fold straight into
+        # the process-default collector (same process by construction)
+        from distkeras_tpu.observability import health as _health
+
+        _health.collector().ingest(report, shard=0)
+        _health.monitor().maybe_check()
+
 
 class ShardedPSClient:
     """Striped worker-side client: the :class:`PSClient` surface over N
@@ -2538,6 +2752,22 @@ class ShardedPSClient:
     def drain(self) -> None:
         for sid, client in enumerate(self.shards):
             self._stripe(sid, client.drain)
+
+    # -- live health plane (ISSUE 8) -------------------------------------------
+    @property
+    def reconnects_used(self) -> int:
+        return sum(c.reconnects_used for c in self.shards)
+
+    @property
+    def failovers_used(self) -> int:
+        return sum(c.failovers_used for c in self.shards)
+
+    def report_health(self, report: Dict[str, Any]) -> None:
+        """Push one report over the SHARD-0 connection only: a striped
+        worker is one logical worker, and the fleet view must count it
+        once (the ``fleet_report`` shard-0 convention; shard 0 exists in
+        every plan)."""
+        self._stripe(0, lambda: self.shards[0].report_health(report))
 
     # -- blocking API ----------------------------------------------------------
     def pull(self) -> List[np.ndarray]:

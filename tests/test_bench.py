@@ -395,6 +395,57 @@ def test_observability_acceptance_block_tripwires():
     assert acc3["straggler_ranked"] is None
 
 
+def test_health_acceptance_block_tripwires():
+    """The issue-8 tripwire block: the fully-on health plane (tracking +
+    streaming collector + detectors) under the 3% wall-overhead target,
+    fleet coverage (every worker reported), reports actually ingested —
+    with None (not a crash) wherever a leg is missing."""
+    out = {
+        "workers": 2,
+        "overhead_pct": 1.1,
+        "collector": {"workers_seen": 2, "reports_ingested": 6,
+                      "tracked_series": 4, "events": 0},
+    }
+    bench._health_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["overhead_ok"] is True and acc["overhead_pct_target"] == 3.0
+    assert acc["fleet_covered"] is True
+    assert acc["reports_ok"] is True
+
+    out2 = {"workers": 4, "overhead_pct": 4.9,
+            "collector": {"workers_seen": 2, "reports_ingested": 0}}
+    bench._health_acceptance(out2)
+    acc2 = out2["acceptance"]
+    assert acc2["overhead_ok"] is False
+    assert acc2["fleet_covered"] is False
+    assert acc2["reports_ok"] is False
+
+    out3 = {}  # the whole leg errored before measuring anything
+    bench._health_acceptance(out3)
+    acc3 = out3["acceptance"]
+    assert acc3["overhead_ok"] is None
+    assert acc3["fleet_covered"] is None
+    assert acc3["reports_ok"] is None
+
+
+@pytest.mark.slow  # ~60-200s of real bench machinery on CPU
+def test_health_bench_runs_tiny():
+    """End-to-end smoke of the issue-8 leg at toy scale: both sub-legs
+    run, the tripwire block attaches, and the on-leg's collector actually
+    saw every worker's reports."""
+    out = bench._bench_health(workers=2, window=2, batch=8,
+                              windows_per_epoch=2, epochs=1, reps=1,
+                              health_interval_s=0.05)
+    assert "acceptance" in out
+    assert out["health_off"]["wall_s"] > 0
+    assert out["health_on"]["wall_s"] > 0
+    assert out["collector"]["workers_seen"] == 2
+    assert out["collector"]["reports_ingested"] >= 2
+    assert out["collector"]["tracked_series"] >= 1
+    assert out["acceptance"]["fleet_covered"] is True
+    assert out["acceptance"]["reports_ok"] is True
+
+
 @pytest.mark.slow  # ~10-70s of bench machinery; the full suite runs it
 def test_moe_acceptance_block_shape():
     """The issue-2 tripwire block: booleans (or None off-TPU) with the

@@ -7,9 +7,8 @@ import numpy as np
 import pytest
 
 from distkeras_tpu.models.base import Model
-from distkeras_tpu.models.decode import (KVCache, forward_with_cache,
-                                         generate, init_cache,
-                                         make_generate_fn)
+from distkeras_tpu.models.decode import (forward_with_cache, generate,
+                                         init_cache, make_generate_fn)
 from distkeras_tpu.models.transformer import small_lm_spec
 
 

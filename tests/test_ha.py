@@ -20,7 +20,6 @@ import numpy as np
 import pytest
 
 from distkeras_tpu import observability as obs
-from distkeras_tpu.runtime import networking as net
 from distkeras_tpu.runtime.faults import ChaosProxy, HubKillPlan
 from distkeras_tpu.runtime.parameter_server import (
     ADAGParameterServer,
@@ -29,7 +28,6 @@ from distkeras_tpu.runtime.parameter_server import (
     PSClient,
     ShardedParameterServer,
     ShardedPSClient,
-    SnapshotSetCoordinator,
     StripeLostError,
     shard_plan,
 )

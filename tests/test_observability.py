@@ -831,22 +831,24 @@ def _ast_unused_imports(path):
     return {name: line for name, line in imported.items() if name not in used}
 
 
-@pytest.mark.parametrize("package", ["observability", "runtime", "."])
+@pytest.mark.parametrize("package", ["observability", "runtime", ".", "tests"])
 def test_package_is_lint_clean(package):
-    """Satellite (PR 5, extended to runtime/ by PR 6 and to the package's
+    """Satellite (PR 5, extended to runtime/ by PR 6, to the package's
     top-level modules — checkpoint.py, utils.py, trainers.py, ... — by
-    PR 7): ruff-clean check scoped to the instrumented packages.  Runs
-    real ruff when the container has it; otherwise falls back to an AST
-    unused-import (F401) sweep plus a compile check.  ``"."`` scans the
-    ``distkeras_tpu/*.py`` files themselves (non-recursive; the
-    subpackages have their own parametrized cells)."""
+    PR 7, and to ``tests/`` itself by PR 8): ruff-clean check scoped to
+    the instrumented packages.  Runs real ruff when the container has it;
+    otherwise falls back to an AST unused-import (F401) sweep plus a
+    compile check.  ``"."`` scans the ``distkeras_tpu/*.py`` files
+    themselves (non-recursive; the subpackages have their own
+    parametrized cells); ``"tests"`` scans this directory."""
     import os
     import py_compile
     import shutil
     import subprocess
 
-    pkg = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                       "distkeras_tpu", package)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = (os.path.join(root, "tests") if package == "tests"
+           else os.path.join(root, "distkeras_tpu", package))
     pkg = os.path.normpath(pkg)
     ruff = shutil.which("ruff")
     if ruff:

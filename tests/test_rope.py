@@ -162,8 +162,7 @@ def test_rope_with_pipeline_schedules():
     from distkeras_tpu.parallel.lm import shift_targets
     from distkeras_tpu.parallel.mesh import create_nd_mesh
     from distkeras_tpu.parallel.pipeline import (
-        make_pp_train_step, merge_block_params, pp_state_shardings,
-        split_block_params)
+        make_pp_train_step, pp_state_shardings, split_block_params)
 
     mesh = create_nd_mesh((2, 2), ("dp", "pp"))
     spec = small_lm_spec(vocab_size=VOCAB, model_dim=D, num_heads=2,

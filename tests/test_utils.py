@@ -5,7 +5,6 @@ import pytest
 
 from distkeras_tpu import utils
 from distkeras_tpu.models.base import Model
-from distkeras_tpu.models.mlp import mnist_mlp_spec
 
 
 def small_mlp():
