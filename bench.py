@@ -2289,6 +2289,138 @@ def _health_acceptance(out: dict) -> None:
     }
 
 
+def _bench_embedding(*, rows: int = 25600, dim: int = 128, fields: int = 2,
+                     batch: int = 32, window: int = 4,
+                     windows_per_epoch: int = 4, epochs: int = 2,
+                     workers: int = 2, reps: int = 3):
+    """Issue-9 row-sparse embedding leg: what does the PS wire COST when a
+    CTR-shaped model (one [rows, dim] table dwarfing the dense head) moves
+    only the rows each window touches?
+
+    Same AsyncADAG / python-hub / pipelined-socket config as the other
+    async legs, run twice on a synthetic CTR log whose per-window batches
+    draw ``batch * window * fields`` uniform ids (~1% of the vocabulary at
+    the default shape):
+
+    - ``dense``: sparse_tables=None — every window moves the whole leaf
+      both ways (today's wire).
+    - ``sparse``: sparse_tables="auto" — pulls carry row-id sets (action
+      S/V), commits carry (row_ids, row_grads) pairs (action U).
+
+    ``wire_bytes`` is the hub's pull+commit byte counters; the EXCHANGE
+    bytes subtract each worker's one initial full-center pull (both legs
+    pay it identically — it seeds the sparse caches), so the tripwire
+    ratio compares the steady-state window exchange the issue is about.
+    Records rows/s (committed rows over the run wall), the measured
+    touched-row fraction, and the issue-9 acceptance tripwire:
+    sparse exchange bytes <= 1.1 x touched_fraction x dense exchange."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.data.ctr import synthetic_ctr_dataset
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.embedding import ctr_embedding_spec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+    from distkeras_tpu.utils import flatten_weights
+
+    # a small head (hidden 8): the leg measures the TABLE's wire story,
+    # and the head rides every sparse frame whole — at CTR shapes the
+    # table dwarfs it, which is the regime the tripwire bound assumes
+    spec = ctr_embedding_spec(rows, dim=dim, fields=fields,
+                              hidden_sizes=(8,))
+    n = workers * batch * window * windows_per_epoch
+    # hot_prob=0: uniform id draws, so the touched fraction is set by
+    # batch*window*fields vs rows (the 1%-fraction shape the tripwire
+    # is phrased at), not by hot-set luck
+    ds = synthetic_ctr_dataset(n, rows, fields=fields, seed=0, hot_prob=0.0)
+    n_windows = workers * windows_per_epoch * epochs
+    flat, _ = flatten_weights(Model.init(spec, seed=0).params)
+    center_bytes = sum(np.asarray(w).nbytes for w in flat)
+
+    def leg(sparse: bool):
+        tr = AsyncADAG(Model.init(spec, seed=0),
+                       loss="categorical_crossentropy", batch_size=batch,
+                       num_epoch=epochs, learning_rate=0.05, seed=0,
+                       num_workers=workers, communication_window=window,
+                       sparse_tables="auto" if sparse else None)
+        tr.train(ds, shuffle=False)  # compile + warm (telemetry off)
+        walls = []
+        counters = {}
+        for _ in range(reps):
+            tr.model = Model.init(spec, seed=0)
+            tr.history = []
+            obs.enable()
+            obs.reset()
+            t0 = time.perf_counter()
+            tr.train(ds, shuffle=False)
+            walls.append(time.perf_counter() - t0)
+            counters = dict(obs.snapshot()["counters"])
+            obs.disable()
+            obs.reset()
+        wall = float(np.median(walls))
+        wire = (counters.get("ps_pull_bytes_total", 0.0)
+                + counters.get("ps_commit_bytes_total", 0.0))
+        exchange = max(0.0, wire - workers * center_bytes)
+        out = {"wall_s": round(wall, 3), "wire_bytes": round(wire),
+               "exchange_bytes": round(exchange)}
+        if sparse:
+            committed = counters.get("ps.sparse_rows_committed", 0.0)
+            out["rows_pulled"] = round(
+                counters.get("ps.sparse_rows_pulled", 0.0))
+            out["rows_committed"] = round(committed)
+            out["rows_per_s"] = (round(committed / wall, 1) if wall > 0
+                                 else None)
+            out["wire_bytes_saved"] = round(
+                counters.get("ps.sparse_wire_bytes_saved", 0.0))
+            out["touched_row_fraction"] = (
+                round(committed / (n_windows * rows), 5)
+                if n_windows * rows else None)
+        return out
+
+    was_enabled = obs.enabled()
+    out = {"rows": rows, "dim": dim, "fields": fields, "batch": batch,
+           "window": window, "epochs": epochs, "workers": workers,
+           "reps": reps, "timing": "wall-median",
+           "table_mb": round(rows * dim * 4 / 2**20, 2),
+           "center_bytes": center_bytes}
+    try:
+        out["dense"] = leg(False)
+        out["sparse"] = leg(True)
+    finally:
+        if was_enabled:
+            obs.enable()
+        else:
+            obs.disable()
+    _embedding_acceptance(out)
+    return out
+
+
+def _embedding_acceptance(out: dict) -> None:
+    """Attach the issue-9 tripwires, in place: the sparse leg's steady-
+    state exchange bytes under ``1.1 x touched_fraction`` of the dense
+    leg's, with a rows/s figure recorded.  Booleans, or None when a leg
+    is missing/errored (graceful degradation, the PR-3 convention)."""
+    dense = out.get("dense") if isinstance(out.get("dense"), dict) else {}
+    sparse = out.get("sparse") if isinstance(out.get("sparse"), dict) else {}
+    dense_bytes = dense.get("exchange_bytes")
+    sparse_bytes = sparse.get("exchange_bytes")
+    frac = sparse.get("touched_row_fraction")
+    ratio = (round(sparse_bytes / dense_bytes, 5)
+             if sparse_bytes and dense_bytes else None)
+    bound = round(1.1 * frac, 5) if frac else None
+    rows_per_s = sparse.get("rows_per_s")
+    out["acceptance"] = {
+        "wire_ratio": ratio,
+        "wire_ratio_bound": bound,
+        "touched_row_fraction": frac,
+        "sparse_wire_ok": (None if ratio is None or bound is None
+                           else bool(ratio <= bound)),
+        "rows_per_s": rows_per_s,
+        "rows_per_s_recorded": (None if rows_per_s is None
+                                else bool(rows_per_s > 0)),
+    }
+
+
 def _leg_ratio(current: float, base: float):
     """current/base rounded, or None when either side is missing/zero."""
     if not current or not base:
@@ -2524,6 +2656,11 @@ def main() -> None:
                 out["health"] = _bench_health()
             except Exception as e:
                 out["health"] = {"error": f"{type(e).__name__}: {e}"}
+            gc.collect()
+            try:
+                out["embedding"] = _bench_embedding()
+            except Exception as e:
+                out["embedding"] = {"error": f"{type(e).__name__}: {e}"}
             _apply_leg_baselines(out, baseline)
     except Exception as e:
         out["value"] = 0.0  # contract: error lines carry the zero sentinel,

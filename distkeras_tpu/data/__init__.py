@@ -5,6 +5,7 @@ rows live in host numpy columns, batches are device-sharded dicts.
 """
 
 from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.ctr import synthetic_ctr_dataset  # noqa: F401
 from distkeras_tpu.data.transformers import (  # noqa: F401
     OneHotTransformer,
     MinMaxTransformer,

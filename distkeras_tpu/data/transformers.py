@@ -9,7 +9,7 @@ new ``Dataset`` with the output column appended.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

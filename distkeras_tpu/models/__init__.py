@@ -17,6 +17,7 @@ import distkeras_tpu.models.resnet  # noqa: F401
 import distkeras_tpu.models.transformer  # noqa: F401
 import distkeras_tpu.models.rnn  # noqa: F401
 import distkeras_tpu.models.sequential  # noqa: F401
+import distkeras_tpu.models.embedding  # noqa: F401
 # the MoE classifier lives with its parallelism machinery but must register
 # here too, or cross-process deserialization can't rebuild it
 import distkeras_tpu.parallel.moe  # noqa: F401  (registers moe_mlp_classifier)

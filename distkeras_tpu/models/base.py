@@ -13,7 +13,7 @@ because SPMD replicas share one traced program.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,34 @@ def build_module(name: str, config: Dict[str, Any]):
     except KeyError:
         raise ValueError(f"unknown architecture {name!r}; known: {sorted(_MODEL_REGISTRY)}") from None
     return cls(**config)
+
+
+def sparse_param_names(spec: "ModelSpec") -> Tuple[str, ...]:
+    """Param-path leaf names this architecture declares as row-sparse
+    ``[rows, dim]`` embedding tables (``sparse_param_names`` on the
+    registered module class; empty for everything else).  This is the
+    EmbeddingTable metadata the async trainers thread into the PS stack
+    (ISSUE 9)."""
+    cls = _MODEL_REGISTRY.get(spec.name)
+    return tuple(getattr(cls, "sparse_param_names", ()) or ())
+
+
+def sparse_leaf_indices(spec: "ModelSpec", params: Any) -> Tuple[int, ...]:
+    """Flat-leaf indices (``jax.tree.flatten`` order — the PS template
+    order) of the spec's declared sparse embedding tables: leaves whose
+    param path ends in one of :func:`sparse_param_names` and that are
+    2-D.  Empty when the architecture declares none."""
+    names = set(sparse_param_names(spec))
+    if not names:
+        return ()
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for idx, (path, leaf) in enumerate(flat):
+        last = path[-1] if path else None
+        key = getattr(last, "key", getattr(last, "name", None))
+        if key in names and getattr(leaf, "ndim", 0) == 2:
+            out.append(idx)
+    return tuple(out)
 
 
 @dataclasses.dataclass(frozen=True)

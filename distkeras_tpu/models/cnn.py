@@ -6,7 +6,7 @@ MXU tiles the channel dim onto lanes).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

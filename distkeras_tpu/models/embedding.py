@@ -1,0 +1,68 @@
+"""Embedding-bag CTR classifier — the row-sparse PS workload (ISSUE 9).
+
+dist-keras's heritage is Spark-ML tabular pipelines; the modern version of
+that workload is CTR/recommender training, where one embedding table
+dwarfs the dense model and every batch touches only the few hundred rows
+its categorical ids name.  This module is the minimal faithful shape of
+that family: ``fields`` categorical id columns over ONE shared vocabulary,
+an embedding-bag reduce (sum over fields), and a small dense head.
+
+The ``EmbeddingTable`` leaf kind is declared DECLARATIVELY: the module
+class lists the param-path names of its row-sparse ``[rows, dim]`` tables
+in ``sparse_param_names``, and :func:`sparse_leaf_indices` (models/base)
+resolves them to flat-leaf indices — the metadata the async trainers
+thread into the PS stack (``sparse_tables="auto"``) so pull/commit traffic
+moves only the rows a batch touches.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.base import ModelSpec, register_model
+
+
+@register_model("embedding_classifier")
+class EmbeddingBagClassifier(nn.Module):
+    """Shared-vocabulary embedding bag + MLP head (logits out).
+
+    Input: int ids ``[batch, fields]`` in ``[0, rows)``.  Each field's id
+    indexes the ONE ``[rows, dim]`` table (flax ``nn.Embed``; its param is
+    named ``embedding`` — the name ``sparse_param_names`` declares); the
+    field vectors are mean-reduced (an "embedding bag"), then a small
+    dense stack emits class logits.  Under any gradient step only the
+    rows present in the batch receive nonzero gradient — the property the
+    row-sparse PS commit path is built on."""
+
+    rows: int
+    dim: int = 16
+    hidden_sizes: Sequence[int] = (32,)
+    num_outputs: int = 2
+
+    # param-path leaf names that are row-sparse [rows, dim] tables — the
+    # EmbeddingTable declaration sparse_leaf_indices() resolves
+    sparse_param_names = ("embedding",)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        emb = nn.Embed(self.rows, self.dim, name="table")(x.astype(jnp.int32))
+        h = emb.mean(axis=1)  # [batch, dim] — the bag reduce
+        for hsz in self.hidden_sizes:
+            h = nn.relu(nn.Dense(hsz)(h))
+        return nn.Dense(self.num_outputs, dtype=jnp.float32)(h)
+
+
+def ctr_embedding_spec(rows: int, dim: int = 16, fields: int = 4,
+                       hidden_sizes: Sequence[int] = (32,),
+                       num_outputs: int = 2) -> ModelSpec:
+    """Spec for the synthetic-CTR example/bench: ``fields`` int32 id
+    columns in, click/no-click logits out."""
+    return ModelSpec(name="embedding_classifier",
+                     config={"rows": int(rows), "dim": int(dim),
+                             "hidden_sizes": tuple(hidden_sizes),
+                             "num_outputs": int(num_outputs)},
+                     input_shape=(int(fields),),
+                     input_dtype="int32")

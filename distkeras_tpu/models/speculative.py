@@ -58,7 +58,7 @@ cannot throttle the live rows' lockstep minimum.
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -403,12 +403,21 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
     window_spans = 0
     commits_total = 0
     commits_with_ctx = 0
+    # row-sparse embedding traffic (ISSUE 9): rows moved, summed over
+    # every shard's spans — per-shard row ranges are disjoint, so the sum
+    # IS the logical row count (no shard-0 dedup needed)
+    sparse_rows_pulled = 0
+    sparse_rows_committed = 0
     failover_ms: List[float] = []
     promotions: List[Dict[str, Any]] = []
     stripes_lost: List[Dict[str, Any]] = []
     for s in spans:
         attrs = s.get("attrs") or {}
         name = s.get("name")
+        if name == "ps.handle_pull" and "sparse_rows" in attrs:
+            sparse_rows_pulled += int(attrs.get("sparse_rows") or 0)
+        elif name == "ps.handle_commit" and "sparse_rows" in attrs:
+            sparse_rows_committed += int(attrs.get("sparse_rows") or 0)
         if name == "async.window" and "worker" in attrs:
             window_spans += 1
             b = bucket(attrs["worker"])
@@ -492,6 +501,9 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         "promotions": promotions,
         "stripes_lost": stripes_lost,
     }
+    if sparse_rows_pulled or sparse_rows_committed:
+        report["sparse"] = {"rows_pulled": sparse_rows_pulled,
+                            "rows_committed": sparse_rows_committed}
     if shards:
         report["shards"] = shards
         report["shards_ranked"] = shards_ranked

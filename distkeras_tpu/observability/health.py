@@ -582,7 +582,7 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         f"distkeras-top — {len(workers)} worker(s), "
         f"{len(events)} event(s)  [{time.strftime('%H:%M:%S')}]",
         f"{'WORKER':>8} {'SHARD':>5} {'WIN/S':>7} {'WALL MS':>9} "
-        f"{'P95 MS':>9} {'STALE':>6} {'RECON':>6} {'AGE S':>6}",
+        f"{'P95 MS':>9} {'STALE':>6} {'RECON':>6} {'ROW/S':>8} {'AGE S':>6}",
     ]
 
     def sort_key(item):
@@ -596,11 +596,16 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         windows = m.get("windows_total") or {}
         stale = m.get("staleness") or {}
         recon = m.get("reconnects_total") or {}
+        # row-sparse embedding traffic (ISSUE 9): committed rows/s from
+        # the worker's cumulative sparse_rows_total series; "-" for
+        # workers (or whole fleets) that move dense leaves only
+        sparse = m.get("sparse_rows_total") or {}
         lines.append(
             f"{w:>8} {_fmt(meta.get('shard')):>5} "
             f"{_fmt(windows.get('rate'), 2):>7} "
             f"{_fmt(wall.get('mean')):>9} {_fmt(wall.get('p95')):>9} "
             f"{_fmt(stale.get('last'), 0):>6} {_fmt(recon.get('last'), 0):>6} "
+            f"{_fmt(sparse.get('rate'), 0):>8} "
             f"{_fmt(meta.get('age_s')):>6}")
     if events:
         lines.append("recent events:")

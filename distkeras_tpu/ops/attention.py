@@ -13,7 +13,6 @@ O(L/sp) memory per chip and the KV transfers ride the ICI ring.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

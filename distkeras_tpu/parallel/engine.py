@@ -18,7 +18,6 @@ host round-trips, the commit is an ICI allreduce fused into the step.
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 

@@ -12,7 +12,7 @@ then spans every host and these helpers build the same mesh over DCN
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

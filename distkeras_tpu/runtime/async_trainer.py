@@ -126,6 +126,7 @@ class AsyncDistributedTrainer(Trainer):
                  ps_idle_timeout: Optional[float] = None,
                  trace_context: Optional[str] = None,
                  health_interval_s: Optional[float] = None,
+                 sparse_tables: Optional[Any] = None,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -316,6 +317,26 @@ class AsyncDistributedTrainer(Trainer):
                     "transport='inproc' (reports fold into the process "
                     "collector directly) or drop native_ps")
         self.health_interval_s = health_interval_s
+        # row-sparse embedding tables (ISSUE 9): None (default) = fully
+        # off, every wire byte identical to the dense stack.  "auto"
+        # resolves the model spec's declared EmbeddingTable leaves
+        # (models.base.sparse_leaf_indices — e.g. the embedding_classifier
+        # family); an explicit iterable names flat-leaf indices directly.
+        # With sparse tables on, each worker pulls only the rows its next
+        # window's batch touches (wire action S/V) and commits
+        # (row_ids, row_grads) pairs (U, or X under int8) — idle rows cost
+        # zero wire bytes; the hub applies them under the same staleness
+        # clock and commit_scale rules as dense commits
+        if sparse_tables is not None and sparse_tables != "auto":
+            sparse_tables = tuple(sorted({int(i) for i in sparse_tables}))
+        self.sparse_tables = sparse_tables
+        if sparse_tables is not None:
+            if native_ps:
+                raise ValueError(
+                    "sparse_tables requires the Python hub (native_ps="
+                    "False): the C++ hub has no sparse pull/commit "
+                    "handlers — drop native_ps, or drop sparse_tables to "
+                    "move full leaves")
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
@@ -335,9 +356,54 @@ class AsyncDistributedTrainer(Trainer):
         """Fault-tolerance + identity kwargs every trainer-owned hub
         (Python or C++) takes; subclass allocators splat this into their
         constructor.  ``shard_id`` tags a sharded hub's telemetry (None on
-        the unsharded path — the exact pre-sharding series)."""
-        return {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id,
-                "replica_of": self.replica_of}
+        the unsharded path — the exact pre-sharding series).  With sparse
+        tables resolved for this run, each hub additionally learns its
+        sparse leaf positions (never added otherwise, so the C++ hub's
+        ctor — which has no such kwarg — stays reachable)."""
+        kw = {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id,
+              "replica_of": self.replica_of}
+        sp = getattr(self, "_hub_sparse", None)
+        if sp is not None:
+            kw["sparse_leaves"] = sp.get(shard_id, ())
+        return kw
+
+    def _resolve_sparse_tables(self, flat: List[np.ndarray]) -> Tuple[int, ...]:
+        """The run's sparse leaf indices: () when off, the spec's declared
+        EmbeddingTable leaves for "auto", or the validated explicit set."""
+        declared = self.sparse_tables
+        if declared is None:
+            return ()
+        if declared == "auto":
+            from distkeras_tpu.models.base import sparse_leaf_indices
+
+            declared = sparse_leaf_indices(self.model.spec,
+                                           self.model.params)
+            if not declared:
+                raise ValueError(
+                    f"sparse_tables='auto' but architecture "
+                    f"{self.model.spec.name!r} declares no sparse embedding "
+                    f"tables (sparse_param_names); name leaf indices "
+                    f"explicitly or drop sparse_tables")
+        # validation below covers BOTH paths: an architecture declaring
+        # mismatched-vocabulary tables must fail at setup too
+        for i in declared:
+            if not 0 <= i < len(flat):
+                raise ValueError(f"sparse_tables index {i} out of range for "
+                                 f"{len(flat)} model leaves")
+            if flat[i].ndim != 2:
+                raise ValueError(f"sparse_tables leaf {i} must be a "
+                                 f"[rows, dim] table, got {flat[i].shape}")
+        # the worker loop sends ONE shared id set to every sparse table
+        # (the shared-vocabulary contract), so unequal row counts would
+        # only surface as a mid-run ValueError on the first out-of-range
+        # id — refuse at setup instead
+        row_counts = {flat[i].shape[0] for i in declared}
+        if len(row_counts) > 1:
+            raise ValueError(
+                f"sparse_tables leaves have mismatched row counts "
+                f"{sorted(row_counts)}: all sparse tables must share one "
+                f"vocabulary (the worker sends one id set per window)")
+        return declared
 
     def _allocate_hub(self, weights: List[np.ndarray],
                       plan) -> Any:
@@ -443,12 +509,30 @@ class AsyncDistributedTrainer(Trainer):
                 f"float32); found dtypes {sorted(bad)} — cast the model's "
                 f"params or use the mesh trainers in distkeras_tpu.trainers")
         flat_f32 = [w.astype(np.float32) for w in flat0]
+        # row-sparse tables (ISSUE 9), resolved against THIS model's leaves
+        sparse_idx = self._resolve_sparse_tables(flat_f32)
+        if sparse_idx and self.transport == "inproc" and self.num_shards > 1:
+            raise ValueError(
+                "sparse_tables with transport='inproc' requires "
+                "num_shards=1 (the sharded facade has no sparse direct "
+                "pair; inproc moves no wire bytes to save anyway) — use "
+                "the socket transport for sharded sparse runs")
+        self._sparse_idx = sparse_idx
         # leaf->shard assignment (deterministic in the model's leaf
         # layout): both ends of a sharded deployment derive the same plan,
         # so worker-only mode agrees with standalone --shard-index hubs
-        plan = (shard_plan(flat_f32, self.num_shards)
+        plan = (shard_plan(flat_f32, self.num_shards,
+                           sparse_leaves=sparse_idx)
                 if self.num_shards > 1 else None)
         self._shard_plan = plan
+        # per-hub sparse positions (None when sparse is off, so no hub
+        # ctor ever sees an unexpected kwarg)
+        if sparse_idx:
+            self._hub_sparse = ({sid: plan.local_sparse(sid)
+                                 for sid in range(plan.num_shards)}
+                                if plan is not None else {None: sparse_idx})
+        else:
+            self._hub_sparse = None
         if self.ps_address is not None:
             ps = None
             addresses = list(self._ps_addresses)
@@ -572,7 +656,8 @@ class AsyncDistributedTrainer(Trainer):
             if self.transport == "inproc":
                 client = InprocPSClient(ps, templates=flat0,
                                         compress=self.compress_commits,
-                                        trace_context=ctx)
+                                        trace_context=ctx,
+                                        sparse_leaves=sparse_idx)
             elif plan is not None:
                 # striped worker: one pipelined connection per shard,
                 # pulls/commits fan out and land per shard (the same
@@ -584,7 +669,8 @@ class AsyncDistributedTrainer(Trainer):
                                          reconnect_backoff=self.reconnect_backoff,
                                          heartbeat_interval=self.heartbeat_interval,
                                          trace_context=ctx,
-                                         failover=self._ps_failover)
+                                         failover=self._ps_failover,
+                                         sparse_leaves=sparse_idx)
             else:
                 client = PSClient(addresses[0][0], addresses[0][1],
                                   templates=flat0,
@@ -595,8 +681,20 @@ class AsyncDistributedTrainer(Trainer):
                                   heartbeat_interval=self.heartbeat_interval,
                                   trace_context=ctx,
                                   failover=(self._ps_failover[0]
-                                            if self._ps_failover else ()))
+                                            if self._ps_failover else ()),
+                                  sparse_leaves=sparse_idx)
             pipeline = self.pipeline
+            # row-sparse exchange (ISSUE 9): each window's pull/commit
+            # carries the sorted-unique row ids its batches touch — the
+            # same id set for every sparse table (the shared-vocabulary
+            # contract of the embedding_classifier family).  Fully inert
+            # when no sparse tables are configured
+            sparse_on = bool(sparse_idx)
+
+            def rows_of(window_x) -> List[np.ndarray]:
+                ids = np.unique(np.asarray(window_x).ravel()
+                                .astype(np.int64))
+                return [ids] * len(sparse_idx)
             # live health plane (ISSUE 8): periodic compact reports to the
             # hub's collector.  Wholly inert when off (health_interval is
             # None -> zero extra calls on the window path)
@@ -606,22 +704,29 @@ class AsyncDistributedTrainer(Trainer):
             h_windows = 0      # cumulative windows this worker ran
             h_wall_ms = 0.0    # window wall accumulated since last report
             h_wall_n = 0
+            h_rows = 0         # cumulative sparse rows this worker committed
 
             def send_health() -> None:
                 nonlocal h_seq, h_wall_ms, h_wall_n
+                metrics = {
+                    # *_total = cumulative (the collector's rate()
+                    # convention); window_wall_ms = point sample (the
+                    # mean since the last report)
+                    "windows_total": float(h_windows),
+                    "window_wall_ms": (h_wall_ms / h_wall_n
+                                       if h_wall_n else None),
+                    "reconnects_total": float(client.reconnects_used),
+                    "failovers_total": float(client.failovers_used),
+                }
+                if sparse_on:
+                    # the health plane sees sparse traffic too: committed
+                    # rows as a cumulative series (rate = rows/s in
+                    # distkeras-top and the live fleet_report)
+                    metrics["sparse_rows_total"] = float(h_rows)
                 client.report_health({
                     "job": trace_job or "local", "worker": idx,
                     "seq": h_seq, "t_wall": time.time(),
-                    "metrics": {
-                        # *_total = cumulative (the collector's rate()
-                        # convention); window_wall_ms = point sample (the
-                        # mean since the last report)
-                        "windows_total": float(h_windows),
-                        "window_wall_ms": (h_wall_ms / h_wall_n
-                                           if h_wall_n else None),
-                        "reconnects_total": float(client.reconnects_used),
-                        "failovers_total": float(client.failovers_used),
-                    }})
+                    "metrics": metrics})
                 h_seq += 1
                 h_wall_ms, h_wall_n = 0.0, 0
             try:
@@ -660,6 +765,10 @@ class AsyncDistributedTrainer(Trainer):
                     feed = (prefetch_to_device(slices, lambda s: s,
                                                metric_prefix="async_feed")
                             if obs.enabled() else slices)
+                    # rows the pending prefetched pull was issued with
+                    # (sparse only): the commit for window w must carry
+                    # the SAME id set its pull asked for
+                    next_rows: Optional[List[np.ndarray]] = None
                     for w, (wx_h, wy_h) in enumerate(feed):
                         if self.fault_hook is not None:
                             self.fault_hook(idx, w)
@@ -667,10 +776,18 @@ class AsyncDistributedTrainer(Trainer):
                         t_wall = (time.perf_counter()
                                   if telemetry or health_interval is not None
                                   else 0.0)
+                        rows_w: Optional[List[np.ndarray]] = None
+                        if sparse_on:
+                            rows_w = (next_rows if next_rows is not None
+                                      else rows_of(xs[w]))
+                            next_rows = None
                         with obs.span("async.window", worker=idx,
                                       epoch=epoch, window=w):
                             if not pull_pending:
-                                client.pull_nowait()
+                                if sparse_on:
+                                    client.pull_nowait(sparse_rows=rows_w)
+                                else:
+                                    client.pull_nowait()
                             pulled_host = client.wait_weights()
                             pull_pending = False
                             # ONE batched H2D per window (center + feed
@@ -691,8 +808,21 @@ class AsyncDistributedTrainer(Trainer):
                             last_window = (w == n_windows - 1
                                            and epoch == self.num_epoch - 1)
                             if pipeline and not last_window:
-                                client.pull_nowait()
-                                pull_pending = True
+                                if sparse_on:
+                                    # sparse prefetch needs the NEXT
+                                    # window's ids, so it stops at the
+                                    # epoch tail (the next epoch's
+                                    # reshuffled slices don't exist yet);
+                                    # window 0 then issues its own pull —
+                                    # one pipeline bubble per epoch
+                                    if w + 1 < n_windows:
+                                        next_rows = rows_of(xs[w + 1])
+                                        client.pull_nowait(
+                                            sparse_rows=next_rows)
+                                        pull_pending = True
+                                else:
+                                    client.pull_nowait()
+                                    pull_pending = True
                             if telemetry:
                                 # block on the window program ONLY when
                                 # measuring: dispatch-to-completion is
@@ -707,9 +837,17 @@ class AsyncDistributedTrainer(Trainer):
                             if pipeline:
                                 # fire-and-forget: the ack coalesces into
                                 # the next window's weights receive
-                                client.commit_nowait(payload)
+                                if sparse_on:
+                                    client.commit_nowait(payload,
+                                                         sparse_rows=rows_w)
+                                else:
+                                    client.commit_nowait(payload)
+                            elif sparse_on:
+                                client.commit(payload, sparse_rows=rows_w)
                             else:
                                 client.commit(payload)
+                        if sparse_on:
+                            h_rows += int(sum(ids.size for ids in rows_w))
                         if telemetry:
                             m_wall.observe(time.perf_counter() - t_wall)
                             m_windows.inc()

@@ -103,7 +103,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            num_shards: int = 1,
                            shard_index: Optional[int] = None,
                            replica_of: Optional[Any] = None,
-                           health_jsonl: Optional[str] = None) -> Any:
+                           health_jsonl: Optional[str] = None,
+                           sparse_tables: Optional[Any] = None) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -150,6 +151,14 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     online detectors over them; ``health_jsonl`` additionally appends
     every :class:`HealthEvent` to that path as JSON lines (durable even
     if the process dies before anyone polls).
+
+    Row-sparse embedding service (ISSUE 9): ``sparse_tables="auto"``
+    registers the model's declared EmbeddingTable leaves
+    (``sparse_param_names`` on the architecture) so workers started with
+    the matching ``sparse_tables`` knob exchange only touched rows; an
+    iterable names flat-leaf indices explicitly.  Both ends derive the
+    same leaf set (and, sharded, the same row-range plan) from the same
+    model — nothing travels on the wire.  Python hub only.
     """
     from distkeras_tpu.runtime.parameter_server import (
         ShardedParameterServer, shard_plan)
@@ -158,6 +167,22 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     flat, _ = flatten_weights(model.params)
     weights = [np.asarray(w, dtype=np.float32) for w in flat]
     num_shards = int(num_shards)
+    if sparse_tables is None:
+        sparse_idx: tuple = ()
+    elif sparse_tables == "auto":
+        from distkeras_tpu.models.base import sparse_leaf_indices
+
+        sparse_idx = sparse_leaf_indices(model.spec, model.params)
+        if not sparse_idx:
+            raise ValueError(
+                f"sparse_tables='auto' but architecture "
+                f"{model.spec.name!r} declares no sparse embedding tables")
+    else:
+        sparse_idx = tuple(sorted({int(i) for i in sparse_tables}))
+    if sparse_idx and native:
+        raise ValueError("sparse_tables requires the Python hub (drop "
+                         "native=True): the C++ hub has no sparse "
+                         "pull/commit handlers")
     if shard_index is not None and not (0 <= int(shard_index) < num_shards):
         raise ValueError(f"shard_index={shard_index} out of range for "
                          f"num_shards={num_shards}")
@@ -171,7 +196,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                              "shard_index: run one standby daemon per "
                              "shard, each pointed at its own primary")
 
-    def make_hub(hub_weights, shard_id, hub_port, own_snapshots=True):
+    def make_hub(hub_weights, shard_id, hub_port, own_snapshots=True,
+                 hub_sparse=()):
         shard_snap = snapshot_dir if own_snapshots else None
         if shard_snap is not None and shard_id is not None:
             shard_snap = os.path.join(shard_snap, f"shard-{shard_id:02d}")
@@ -179,6 +205,10 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                       snapshot_interval=snapshot_interval,
                       restore=restore if own_snapshots else False,
                       shard_id=shard_id)
+        if hub_sparse:
+            # only added when sparse is actually on, so the C++ hub's
+            # ctor (no such kwarg) stays reachable on the dense path
+            common["sparse_leaves"] = hub_sparse
         if native:
             from distkeras_tpu.runtime.native import (
                 MODE_ADAG, MODE_DELTA, MODE_DYNSGD, NativeParameterServer)
@@ -210,13 +240,16 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
         _health.monitor().jsonl_path = str(health_jsonl)
 
     if num_shards == 1:
-        ps = make_hub(weights, None, port)
+        ps = make_hub(weights, None, port, hub_sparse=sparse_idx)
     else:
-        plan = shard_plan(weights, num_shards)
+        plan = shard_plan(weights, num_shards, sparse_leaves=sparse_idx)
         if shard_index is not None:
             sid = int(shard_index)
-            ps = make_hub([weights[i] for i in plan.assignments[sid]],
-                          sid, port)
+            # plan.split row-slices sparse tables; the pre-sparse
+            # assignment indexing stays byte-identical when nothing is
+            # sparse (split is then exactly the indexed selection)
+            ps = make_hub(plan.split(weights)[sid],
+                          sid, port, hub_sparse=plan.local_sparse(sid))
         else:
             # all shards in one process: consecutive ports from --port, or
             # all-ephemeral when port=0 (a fixed port can only bind once).
@@ -226,7 +259,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
             ps = ShardedParameterServer(
                 weights, plan,
                 lambda w, sid: make_hub(w, sid, port + sid if port else 0,
-                                        own_snapshots=False),
+                                        own_snapshots=False,
+                                        hub_sparse=plan.local_sparse(sid)),
                 snapshot_dir=snapshot_dir,
                 snapshot_interval=snapshot_interval,
                 restore=restore)
@@ -281,6 +315,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "replication lag, throughput regression) to "
                              "this file as JSON lines; live view: "
                              "distkeras-top against a punchcard daemon")
+    parser.add_argument("--sparse-tables", default=None, metavar="SPEC",
+                        help="row-sparse embedding service (Python hub "
+                             "only): 'auto' registers the model's declared "
+                             "EmbeddingTable leaves, or a comma-separated "
+                             "list of flat-leaf indices; workers started "
+                             "with the matching sparse_tables knob then "
+                             "exchange only the rows each batch touches")
     parser.add_argument("--replica-of", default=None, metavar="HOST:PORT",
                         help="start as a hot standby of the primary hub at "
                              "this address: serve pulls immediately, stream "
@@ -308,6 +349,21 @@ def main(argv: Optional[List[str]] = None) -> None:
             parser.error(f"--replica-of expects HOST:PORT, got "
                          f"{args.replica_of!r}")
         replica_of = (host_part, int(port_part))
+    sparse_tables: Optional[Any] = None
+    if args.sparse_tables:
+        if args.native:
+            parser.error("--sparse-tables requires the Python hub (drop "
+                         "--native): the C++ hub has no sparse handlers")
+        if args.sparse_tables == "auto":
+            sparse_tables = "auto"
+        else:
+            try:
+                sparse_tables = tuple(
+                    int(p) for p in args.sparse_tables.split(",") if p)
+            except ValueError:
+                parser.error(f"--sparse-tables expects 'auto' or a comma-"
+                             f"separated index list, got "
+                             f"{args.sparse_tables!r}")
 
     from distkeras_tpu.models.base import Model
 
@@ -324,7 +380,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                                 num_shards=args.num_shards,
                                 shard_index=args.shard_index,
                                 replica_of=replica_of,
-                                health_jsonl=args.health_jsonl)
+                                health_jsonl=args.health_jsonl,
+                                sparse_tables=sparse_tables)
     if replica_of is not None:
         print(f"ps standby (replica of {replica_of[0]}:{replica_of[1]}) "
               f"listening on {args.host}:{ps.port}", flush=True)

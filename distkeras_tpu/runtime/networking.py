@@ -111,6 +111,36 @@ REPL_DELTA = 0  # primary->replica: blobs[1:] = scaled applied delta
 REPL_SYNC = 1   # primary->replica: blobs[1:] = full center at `clock`
 REPL_HELLO = 2  # replica->primary: no tensor blobs; `clock` = replica's clock
 
+# row-sparse embedding traffic (ISSUE 9): a worker whose model declares
+# EmbeddingTable leaves (shape [rows, dim], registered as ``sparse_leaves``
+# on both ends) exchanges only the rows a batch touches —
+#
+#   ``S`` sparse pull request: one int64 sorted-unique row-id blob per
+#         sparse table (ascending leaf order); dense leaves need no
+#         request payload, they always ride the reply whole.
+#   ``V`` sparse weights reply: one blob per CENTER LEAF in template
+#         order — the full leaf (f32) for dense leaves, the requested
+#         ``[k, dim]`` row block (f32) for sparse leaves.
+#   ``U`` sparse f32 commit: per leaf in template order — one full f32
+#         delta blob for dense leaves, TWO blobs (int64 row ids, f32
+#         ``[k, dim]`` row grads) for sparse leaves.
+#   ``X`` sparse int8 commit: same layout with every value blob carried
+#         as a ``Q`` blob (be-f32 scale + int8 values; the row block is
+#         quantized as one unit).
+#
+# Row ids are int64 in native byte order — the same raw-tensor-bytes
+# convention every other blob uses — sorted and unique, so the hub's
+# ``center[ids] += rows`` apply is race-free under its lock.  Opt-in like
+# ``T``/``M``/``R``: no S/V/U/X frame ever moves unless BOTH ends declare
+# sparse tables, so every pre-existing frame stays byte-identical and
+# un-upgraded peers interoperate unchanged.
+ACTION_SPARSE_PULL = b"S"
+ACTION_SPARSE_WEIGHTS = b"V"
+ACTION_SPARSE_COMMIT = b"U"
+ACTION_SPARSE_QCOMMIT = b"X"
+
+ROW_ID_DTYPE = np.dtype(np.int64)
+
 
 class ProtocolError(ValueError):
     """A frame violated the wire contract: garbage/oversized length prefix,
@@ -550,6 +580,69 @@ class FlatFrameCodec:
         # limit=payload_len rejects any differently-sized frame outright)
         return _scatter_recv_into(sock, out, self._scratch,
                                   limit=self.payload_len)
+
+
+class VarFrameEncoder:
+    """:class:`FlatFrameCodec`'s zero-intermediate-bytes packing for frames
+    whose blob count/sizes vary per message — the sparse pull/commit plane
+    (actions ``S``/``V``/``U``/``X``), where each frame's row blobs are
+    sized by whatever the batch touched.
+
+    One grow-once tx buffer: per message the header, action, count and
+    per-blob length prefixes are stamped in and each blob is memcpy'd into
+    place, then the whole frame leaves in a single ``sendall`` — no
+    per-blob ``tobytes()``, no ``join``.  Wire bytes are IDENTICAL to
+    :func:`encode_tensors`, so generic peers decode these frames with the
+    ordinary :func:`decode_tensor_views` path.  Not thread-safe (one
+    encoder per connection owner); :meth:`pack`'s returned view aliases
+    the buffer and is valid until the next pack."""
+
+    def __init__(self, initial: int = 4096):
+        self._tx = bytearray(int(initial))
+        self.frame_len = 0  # of the most recent pack
+
+    def pack(self, action: bytes, arrays: Sequence[np.ndarray]) -> memoryview:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        payload = 5 + sum(8 + a.nbytes for a in arrays)
+        total = 8 + payload
+        if len(self._tx) < total:
+            self._tx = bytearray(total)
+        struct.pack_into(">Q", self._tx, 0, payload)
+        self._tx[8:9] = action
+        struct.pack_into(">I", self._tx, 9, len(arrays))
+        mv = memoryview(self._tx)
+        pos = 13
+        for a in arrays:
+            struct.pack_into(">Q", self._tx, pos, a.nbytes)
+            pos += 8
+            if a.nbytes:
+                mv[pos:pos + a.nbytes] = memoryview(a).cast("B")
+            pos += a.nbytes
+        self.frame_len = total
+        return mv[:total]
+
+    def send(self, sock: socket.socket, action: bytes,
+             arrays: Sequence[np.ndarray]) -> int:
+        """Pack and send one frame; returns its full on-the-wire length."""
+        frame = self.pack(action, arrays)
+        sock.sendall(frame)
+        if obs.enabled():
+            obs.counter("net_tx_frames_total").inc()
+            obs.counter("net_tx_bytes_total").inc(self.frame_len)
+        return self.frame_len
+
+
+def normalize_row_ids(ids, rows: int) -> np.ndarray:
+    """Canonical wire form of one sparse table's touched-row set: flat
+    int64, sorted, unique, bounds-checked against the table's ``rows``.
+    The sorted-unique contract is what makes the hub's fancy-indexed
+    ``center[ids] += grads`` apply exact (duplicate ids would drop all
+    but one addend)."""
+    arr = np.unique(np.asarray(ids).ravel().astype(ROW_ID_DTYPE, copy=False))
+    if arr.size and (arr[0] < 0 or arr[-1] >= rows):
+        raise ValueError(f"row ids outside [0, {rows}): "
+                         f"[{arr[0]}, {arr[-1]}]")
+    return arr
 
 
 # -- int8 commit compression (action Q blobs) ---------------------------------

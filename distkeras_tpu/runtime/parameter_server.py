@@ -328,7 +328,8 @@ class SocketParameterServer:
                  shard_id: Optional[int] = None,
                  replica_of: Optional[Tuple[str, int]] = None,
                  replica_feed_retries: int = 3,
-                 replica_feed_backoff: float = 0.2):
+                 replica_feed_backoff: float = 0.2,
+                 sparse_leaves: Sequence[int] = ()):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
@@ -360,6 +361,23 @@ class SocketParameterServer:
         self._conn_lock = threading.Lock()
         self._running = False
         self._center_bytes = sum(w.nbytes for w in self.center)
+        # row-sparse embedding tables (ISSUE 9): leaf indices whose PS
+        # traffic is row-sparse — pulled by row set (action S/V) and
+        # committed as (row_ids, row_grads) pairs (action U/X) under the
+        # SAME staleness clock and commit_scale rules as dense commits.
+        # Empty (the default) keeps every path byte-for-byte pre-sparse;
+        # a sparse-capable hub still serves the full dense P/C/Q exchange
+        # too (initial syncs, control clients, un-upgraded workers)
+        self.sparse_leaves = tuple(sorted({int(i) for i in sparse_leaves}))
+        for i in self.sparse_leaves:
+            if not 0 <= i < len(self.center):
+                raise ValueError(f"sparse leaf index {i} out of range for "
+                                 f"{len(self.center)} center leaves")
+            if self.center[i].ndim != 2:
+                raise ValueError(
+                    f"sparse leaf {i} must be a [rows, dim] table, got "
+                    f"shape {self.center[i].shape}")
+        self._sparse_set = frozenset(self.sparse_leaves)
         # full flat-frame size of a pull reply / f32 commit (header, action,
         # count, per-tensor prefixes, payload) — the socket-buffer hint.
         # A shard hub computes this from ITS center subset, so per-shard
@@ -375,6 +393,15 @@ class SocketParameterServer:
         self._max_payload = max(
             5 + sum(8 + max(w.nbytes, 4 + w.size) for w in self.center),
             net.CONTROL_PAYLOAD_MAX)
+        if self.sparse_leaves:
+            # a sparse f32 commit touching every row adds one int64 id
+            # blob (8 bytes/row + its prefix) per table on top of the
+            # dense commit's bound
+            self._max_payload = max(
+                self._max_payload,
+                5 + sum(8 + max(w.nbytes, 4 + w.size) for w in self.center)
+                + sum(8 + 8 * self.center[i].shape[0]
+                      for i in self.sparse_leaves))
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
         # half-open liveness: a peer that dies without FIN used to park its
         # handler in recv() forever.  With idle_timeout set, a connection
@@ -915,6 +942,115 @@ class SocketParameterServer:
         return [net.dequantize_q_blob(blob, c.size).reshape(c.shape)
                 for blob, c in zip(blobs, self.center)]
 
+    # -- row-sparse embedding traffic (ISSUE 9) --------------------------------
+    def _q_payload_bytes(self) -> int:
+        """Payload bytes of a DENSE int8 (action Q) commit over this
+        center — the like-for-like baseline ``ps.sparse_wire_bytes_saved``
+        compares an X commit against."""
+        return 5 + sum(8 + 4 + w.size for w in self.center)
+
+    def _check_row_ids(self, ids: np.ndarray, leaf: int) -> np.ndarray:
+        """Validate one table's wire row-id blob: int64, in-bounds,
+        strictly ascending (sorted AND unique — what makes the
+        fancy-indexed ``center[ids] += grads`` apply exact)."""
+        rows = self.center[leaf].shape[0]
+        if ids.size:
+            if ids[0] < 0 or ids[-1] >= rows:
+                raise ValueError(f"sparse leaf {leaf}: row ids outside "
+                                 f"[0, {rows})")
+            if ids.size > 1 and not (np.diff(ids) > 0).all():
+                raise ValueError(f"sparse leaf {leaf}: row ids must be "
+                                 f"sorted and unique")
+        return ids
+
+    def _decode_sparse_ids(self, blobs) -> List[np.ndarray]:
+        """Action-``S`` request payload -> one validated id array per
+        sparse table (ascending leaf order).  The arrays are views into
+        the connection's receive buffer — consumed before the next frame
+        lands, like every other wire view."""
+        if len(blobs) != len(self.sparse_leaves):
+            raise ValueError(f"sparse pull has {len(blobs)} id blobs, hub "
+                             f"has {len(self.sparse_leaves)} sparse tables")
+        return [self._check_row_ids(np.frombuffer(blob, net.ROW_ID_DTYPE), i)
+                for blob, i in zip(blobs, self.sparse_leaves)]
+
+    def _decode_sparse_commit(self, blobs, quantized: bool) -> List[Any]:
+        """Action-``U``/``X`` payload -> per-leaf parts aligned with the
+        center: a full delta array for dense leaves, an ``(ids, grads)``
+        pair for sparse leaves."""
+        expected = len(self.center) + len(self.sparse_leaves)
+        if len(blobs) != expected:
+            raise ValueError(f"sparse commit has {len(blobs)} blobs, "
+                             f"expected {expected}")
+        parts: List[Any] = []
+        it = iter(blobs)
+        for i, c in enumerate(self.center):
+            if i in self._sparse_set:
+                ids = self._check_row_ids(
+                    np.frombuffer(next(it), net.ROW_ID_DTYPE), i)
+                dim = c.shape[1]
+                blob = next(it)
+                if quantized:
+                    grads = net.dequantize_q_blob(blob, ids.size * dim)
+                else:
+                    grads = np.frombuffer(blob, np.float32)
+                    if grads.size != ids.size * dim:
+                        raise ValueError(
+                            f"sparse leaf {i}: {grads.size} grad values for "
+                            f"{ids.size} rows of dim {dim}")
+                parts.append((ids, grads.reshape(ids.size, dim)))
+            else:
+                blob = next(it)
+                if quantized:
+                    arr = net.dequantize_q_blob(blob, c.size).reshape(c.shape)
+                else:
+                    arr = np.frombuffer(blob, np.float32)
+                    if arr.size != c.size:
+                        raise ValueError(f"commit tensor size {arr.size} != "
+                                         f"center size {c.size}")
+                    arr = arr.reshape(c.shape)
+                parts.append(arr)
+        return parts
+
+    def _apply_sparse_commit_locked(self, parts: Sequence[Any],
+                                    staleness: int) -> Optional[List[np.ndarray]]:
+        """Sparse analogue of :meth:`_apply_commit_locked` (caller holds
+        the center lock): dense leaves apply exactly like a dense commit,
+        sparse leaves apply only their touched rows —
+        ``center[ids] += commit_scale(staleness) * grads`` — under the
+        SAME staleness clock and scaling rule the dense paths and the
+        replication feed already share.  When a replica is attached the
+        full scaled delta is materialized (idle rows as zeros) so the
+        existing center-shaped R codec carries the applied row deltas
+        unchanged; returns it for the feed, else None."""
+        feed = self._feed
+        replicate = feed is not None and feed.active()
+        scale = np.float32(self.commit_scale(staleness))
+        one = scale == np.float32(1.0)
+        scaled: Optional[List[np.ndarray]] = [] if replicate else None
+        for c, p in zip(self.center, parts):
+            if isinstance(p, tuple):
+                ids, grads = p
+                g = grads if one else grads * scale
+                if replicate:
+                    full = np.zeros_like(c)
+                    if ids.size:
+                        full[ids] = g
+                    scaled.append(full)
+                if ids.size:
+                    c[ids] += g
+            else:
+                arr = np.asarray(p, np.float32)
+                g = arr if one else arr * scale
+                if replicate:
+                    # an OWNED copy for the feed (wire deltas are views
+                    # into the receive buffer) — `* scale` above already
+                    # owns except on the scale-1 fast path
+                    g = np.array(g, np.float32) if one else g
+                    scaled.append(g)
+                c += g
+        return scaled
+
     def _handle_connection(self, conn: socket.socket, conn_idx: int = 0) -> None:
         # connections born after a restore start AT the fence: their first
         # commit-before-pull is stale relative to the restart point, not to
@@ -935,6 +1071,10 @@ class SocketParameterServer:
         # — steady-state the handler loop allocates nothing
         rx = bytearray(self._frame_bytes)
         reply = net.FlatFrameCodec(self.center)
+        # sparse replies vary per message (row blobs sized by the request),
+        # so they ride a grow-once variable encoder instead of the fixed
+        # codec; None on a dense hub — zero cost when sparse is off
+        sp_enc = net.VarFrameEncoder() if self.sparse_leaves else None
         ack = net.empty_tensor_frame(net.ACTION_ACK)
         # set when this connection turns out to be a replica handshake: the
         # socket's ownership moves to the replication feed and this thread
@@ -1075,6 +1215,115 @@ class SocketParameterServer:
                         # the quantity DynSGD scales by, now visible for
                         # EVERY hub flavor.  Created lazily so a hub with
                         # telemetry off never registers per-connection state
+                        obs.gauge("ps_staleness", conn=str(conn_idx),
+                                  **self._mlabels).set(staleness)
+                        obs.histogram("ps_commit_staleness",
+                                      **self._mlabels).observe(staleness)
+                elif action == net.ACTION_SPARSE_PULL:
+                    if sp_enc is None:
+                        raise net.ProtocolError(
+                            "sparse pull against a hub with no sparse "
+                            "tables (pass sparse_leaves to the hub)")
+                    if self._standby and not self._synced.is_set():
+                        raise net.ProtocolError(
+                            "pull from a never-synced standby refused "
+                            "(it holds no job state yet)")
+                    ids_list = self._decode_sparse_ids(blobs)
+                    rows_pulled = int(sum(ids.size for ids in ids_list))
+                    with obs.span("ps.handle_pull", conn=conn_idx,
+                                  sparse_rows=rows_pulled,
+                                  **self._shard_attrs, **ctx_attrs):
+                        with self._lock:
+                            # fancy-indexed row gathers copy; dense leaves
+                            # are memcpy'd straight into the frame by
+                            # pack() — all under the lock, send after
+                            it = iter(ids_list)
+                            arrays = [self.center[i][next(it)]
+                                      if i in self._sparse_set
+                                      else self.center[i]
+                                      for i in range(len(self.center))]
+                            frame = sp_enc.pack(net.ACTION_SPARSE_WEIGHTS,
+                                                arrays)
+                            last_pull_clock = self._clock
+                        net.send_raw_frame(conn, frame)
+                    if telemetry:
+                        obs.counter("ps_pulls_total", **self._mlabels).inc()
+                        # raw tensor bytes, the same basis the dense pull
+                        # (_center_bytes) and both commit paths use — the
+                        # bench's sparse-vs-dense ratio must not compare
+                        # framed bytes against raw bytes
+                        obs.counter("ps_pull_bytes_total",
+                                    **self._mlabels).inc(
+                            sum(a.nbytes for a in arrays))
+                        obs.counter("ps.sparse_rows_pulled",
+                                    **self._mlabels).inc(rows_pulled)
+                        obs.counter("ps.sparse_wire_bytes_saved",
+                                    **self._mlabels).inc(
+                            max(0, self._frame_bytes - sp_enc.frame_len))
+                        obs.histogram("ps_rpc_seconds", rpc="pull",
+                                      **self._mlabels).observe(
+                            time.perf_counter() - t0)
+                elif action in (net.ACTION_SPARSE_COMMIT,
+                                net.ACTION_SPARSE_QCOMMIT):
+                    if not self.sparse_leaves:
+                        raise net.ProtocolError(
+                            "sparse commit against a hub with no sparse "
+                            "tables (pass sparse_leaves to the hub)")
+                    parts = self._decode_sparse_commit(
+                        blobs,
+                        quantized=(action == net.ACTION_SPARSE_QCOMMIT))
+                    if self._standby:
+                        if not self._synced.is_set():
+                            raise net.ProtocolError(
+                                "commit into a never-synced standby "
+                                "refused (it has no state to take over)")
+                        self._standby_commit_gate()
+                        self.promote(reason="commit received while standby "
+                                            "(worker failed over)")
+                    if not joined:
+                        joined = True
+                        self._member_join(member_token)
+                    rows_committed = int(sum(
+                        p[0].size for p in parts if isinstance(p, tuple)))
+                    with obs.span("ps.handle_commit", conn=conn_idx,
+                                  sparse_rows=rows_committed,
+                                  **self._shard_attrs, **ctx_attrs) as sp:
+                        with self._lock:
+                            if last_pull_clock < self._clock_fence:
+                                last_pull_clock = self._clock_fence
+                                if telemetry:
+                                    obs.counter("ps_fenced_commits_total",
+                                                **self._mlabels).inc()
+                            staleness = self._clock - last_pull_clock
+                            scaled = self._apply_sparse_commit_locked(
+                                parts, staleness)
+                            self.num_updates += 1
+                            self._clock += 1
+                            commit_clock = self._clock
+                        if scaled is not None:
+                            self._feed.publish(commit_clock, scaled)
+                        net.send_raw_frame(conn, ack)
+                        if getattr(sp, "attrs", None) is not None:
+                            sp.attrs["staleness"] = staleness
+                    self._observe_health(ctx_attrs.get("worker"),
+                                         "staleness", staleness)
+                    if telemetry:
+                        wire = sum(b.nbytes for b in blobs)
+                        dense_equiv = (
+                            self._frame_bytes - 8
+                            if action == net.ACTION_SPARSE_COMMIT
+                            else self._q_payload_bytes())
+                        obs.counter("ps_commits_total", **self._mlabels).inc()
+                        obs.counter("ps_commit_bytes_total",
+                                    **self._mlabels).inc(wire)
+                        obs.counter("ps.sparse_rows_committed",
+                                    **self._mlabels).inc(rows_committed)
+                        obs.counter("ps.sparse_wire_bytes_saved",
+                                    **self._mlabels).inc(
+                            max(0, dense_equiv - wire))
+                        obs.histogram("ps_rpc_seconds", rpc="commit",
+                                      **self._mlabels).observe(
+                            time.perf_counter() - t0)
                         obs.gauge("ps_staleness", conn=str(conn_idx),
                                   **self._mlabels).set(staleness)
                         obs.histogram("ps_commit_staleness",
@@ -1250,6 +1499,111 @@ class SocketParameterServer:
             obs.histogram("ps_commit_staleness",
                           **self._mlabels).observe(staleness)
 
+    def pull_sparse_direct(self, ids_list: Sequence[np.ndarray]
+                           ) -> Tuple[List[Any], int]:
+        """The S/V exchange minus the frame (InprocPSClient's sparse
+        path): one validated sorted-unique id array per sparse table in,
+        ``(per-leaf values, clock)`` out — full copies for dense leaves,
+        the requested ``[k, dim]`` row blocks for sparse leaves."""
+        if not self.sparse_leaves:
+            raise RuntimeError("pull_sparse_direct on a hub with no sparse "
+                               "tables (pass sparse_leaves to the hub)")
+        if self._standby and not self._synced.is_set():
+            raise RuntimeError(
+                "pull_sparse_direct from a never-synced standby refused "
+                "(it holds no job state yet); wait_synced() first")
+        if len(ids_list) != len(self.sparse_leaves):
+            # checked BEFORE the zip below, which would silently truncate
+            raise ValueError(f"got {len(ids_list)} id arrays, hub has "
+                             f"{len(self.sparse_leaves)} sparse tables")
+        ids_list = [self._check_row_ids(
+            np.asarray(ids, net.ROW_ID_DTYPE), i)
+            for ids, i in zip(ids_list, self.sparse_leaves)]
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        rows_pulled = int(sum(ids.size for ids in ids_list))
+        with obs.span("ps.handle_pull", transport="inproc",
+                      sparse_rows=rows_pulled, **self._shard_attrs,
+                      **dtrace.current_span_attrs()):
+            with self._lock:
+                it = iter(ids_list)
+                values: List[Any] = [
+                    self.center[i][next(it)] if i in self._sparse_set
+                    else self.center[i].copy()
+                    for i in range(len(self.center))]
+                clock = self._clock
+        if telemetry:
+            obs.counter("ps_pulls_total", **self._mlabels).inc()
+            obs.counter("ps.sparse_rows_pulled",
+                        **self._mlabels).inc(rows_pulled)
+            obs.histogram("ps_rpc_seconds", rpc="pull.inproc",
+                          **self._mlabels).observe(time.perf_counter() - t0)
+        return values, clock
+
+    def commit_sparse_direct(self, parts: Sequence[Any],
+                             last_pull_clock: int) -> None:
+        """Apply one row-sparse commit (the U exchange minus the frame):
+        ``parts`` aligned with the center — full f32 delta for dense
+        leaves, ``(ids, grads)`` for sparse leaves — with the staleness
+        implied by ``last_pull_clock``."""
+        if not self.sparse_leaves:
+            raise RuntimeError("commit_sparse_direct on a hub with no "
+                               "sparse tables (pass sparse_leaves)")
+        if len(parts) != len(self.center):
+            raise ValueError(f"commit has {len(parts)} parts, center has "
+                             f"{len(self.center)}")
+        norm: List[Any] = []
+        for i, (p, c) in enumerate(zip(parts, self.center)):
+            if i in self._sparse_set:
+                ids, grads = p
+                ids = self._check_row_ids(np.asarray(ids, net.ROW_ID_DTYPE), i)
+                grads = np.asarray(grads, np.float32).reshape(
+                    ids.size, c.shape[1])
+                norm.append((ids, grads))
+            else:
+                norm.append(np.asarray(p, np.float32).reshape(c.shape))
+        telemetry = obs.enabled()
+        t0 = time.perf_counter() if telemetry else 0.0
+        if self._standby:
+            if not self._synced.is_set():
+                raise RuntimeError(
+                    "commit_sparse_direct into a never-synced standby "
+                    "refused (it has no state to take over); "
+                    "wait_synced() first")
+            self._standby_commit_gate()
+            self.promote(reason="commit_sparse_direct while standby")
+        rows_committed = int(sum(
+            p[0].size for p in norm if isinstance(p, tuple)))
+        with obs.span("ps.handle_commit", transport="inproc",
+                      sparse_rows=rows_committed, **self._shard_attrs,
+                      **dtrace.current_span_attrs()) as sp:
+            with self._lock:
+                if last_pull_clock < self._clock_fence:
+                    last_pull_clock = self._clock_fence
+                    if telemetry:
+                        obs.counter("ps_fenced_commits_total",
+                                    **self._mlabels).inc()
+                staleness = self._clock - last_pull_clock
+                scaled = self._apply_sparse_commit_locked(norm, staleness)
+                self.num_updates += 1
+                self._clock += 1
+                commit_clock = self._clock
+            if scaled is not None:
+                self._feed.publish(commit_clock, scaled)
+            if getattr(sp, "attrs", None) is not None:
+                sp.attrs["staleness"] = staleness
+        if self._health is not None:
+            self._observe_health(dtrace.current_span_attrs().get("worker"),
+                                 "staleness", staleness)
+        if telemetry:
+            obs.counter("ps_commits_total", **self._mlabels).inc()
+            obs.counter("ps.sparse_rows_committed",
+                        **self._mlabels).inc(rows_committed)
+            obs.histogram("ps_rpc_seconds", rpc="commit.inproc",
+                          **self._mlabels).observe(time.perf_counter() - t0)
+            obs.histogram("ps_commit_staleness",
+                          **self._mlabels).observe(staleness)
+
     # -- commit rules ----------------------------------------------------------
     def apply_commit(self, delta: List[np.ndarray], staleness: int) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -1408,6 +1762,78 @@ def _quantize_commit(delta: Sequence[np.ndarray],
     return blobs
 
 
+def _sparse_commit_arrays(delta: Sequence[np.ndarray],
+                          templates: Sequence[np.ndarray],
+                          sparse_set, ids_list: Sequence[np.ndarray],
+                          residual: Optional[List[np.ndarray]],
+                          compress: Optional[str]) -> List[np.ndarray]:
+    """Full-order delta + per-table touched-row ids -> the U/X wire blob
+    arrays (advancing the int8 residuals in place) — the one
+    implementation both transports share, so the row-gather and
+    quantize/residual math can never fork between sockets and inproc.
+
+    int8 residuals use the documented DENSE-residual fallback: one
+    full-table float32 residual per sparse leaf (the same array the dense
+    path would keep), indexed by the touched rows — per-row error
+    feedback without a second bookkeeping structure.  Each table's row
+    block is quantized as ONE unit (one scale for the [k, dim] block)."""
+    arrays: List[np.ndarray] = []
+    it = iter(ids_list)
+    for i, d in enumerate(delta):
+        if i in sparse_set:
+            ids = next(it)
+            rows = np.ascontiguousarray(np.asarray(d, np.float32)[ids])
+            if compress == "int8":
+                carried = rows + residual[i][ids]
+                blob, r = net.quantize_q_blob(carried)
+                residual[i][ids] = r
+                arrays.append(ids)
+                arrays.append(np.frombuffer(blob, np.uint8))
+            else:
+                arrays.append(ids)
+                arrays.append(rows)
+        else:
+            if compress == "int8":
+                carried = np.asarray(d, np.float32) + residual[i]
+                blob, residual[i] = net.quantize_q_blob(carried)
+                arrays.append(np.frombuffer(blob, np.uint8))
+            else:
+                arrays.append(np.asarray(d, np.float32))
+    return arrays
+
+
+def _sparse_parts_from_arrays(arrays: Sequence[np.ndarray],
+                              templates: Sequence[np.ndarray],
+                              sparse_set,
+                              compress: Optional[str]) -> List[Any]:
+    """Inverse of :func:`_sparse_commit_arrays` at the VALUE level: what
+    the hub would reconstruct from those wire blobs — the inproc client
+    round-trips every sparse commit through this so compressed inproc
+    runs stay trajectory-identical to the wire (the
+    ``tests/test_transport.py`` contract, extended to sparse)."""
+    parts: List[Any] = []
+    it = iter(arrays)
+    for i, t in enumerate(templates):
+        if i in sparse_set:
+            ids = next(it)
+            val = next(it)
+            dim = t.shape[1]
+            if compress == "int8":
+                grads = net.dequantize_q_blob(
+                    memoryview(val), ids.size * dim).reshape(ids.size, dim)
+            else:
+                grads = val
+            parts.append((ids, grads))
+        else:
+            val = next(it)
+            if compress == "int8":
+                parts.append(net.dequantize_q_blob(
+                    memoryview(val), t.size).reshape(t.shape))
+            else:
+                parts.append(val)
+    return parts
+
+
 _CLIENT_ORDINALS = itertools.count()
 
 
@@ -1487,11 +1913,38 @@ class PSClient:
                  heartbeat_interval: Optional[float] = None,
                  trace_context: Optional["dtrace.TraceContext"] = None,
                  shard_id: Optional[int] = None,
-                 failover: Sequence[Tuple[str, int]] = ()):
+                 failover: Sequence[Tuple[str, int]] = (),
+                 sparse_leaves: Sequence[int] = ()):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
         self.compress = compress
+        # row-sparse embedding tables (ISSUE 9): leaf indices exchanged by
+        # row set.  The client keeps one full-size host CACHE per table: a
+        # full pull (sparse_rows=None) seeds it, each sparse pull merges
+        # just the touched rows into it, and wait_weights hands the cache
+        # out in place of a landing buffer — callers see full-order weight
+        # lists either way while only touched rows cross the wire.  Rows
+        # the hub updated that this worker never re-pulls stay stale in
+        # the cache, which is exactly the per-row staleness the async
+        # algorithms already tolerate (untouched rows also receive no
+        # gradient, so their committed delta is zero)
+        self._sparse = tuple(sorted({int(i) for i in sparse_leaves}))
+        for i in self._sparse:
+            if not 0 <= i < len(self.templates):
+                raise ValueError(f"sparse leaf index {i} out of range for "
+                                 f"{len(self.templates)} templates")
+            if self.templates[i].ndim != 2:
+                raise ValueError(f"sparse leaf {i} must be a [rows, dim] "
+                                 f"table, got {self.templates[i].shape}")
+        self._sparse_set = frozenset(self._sparse)
+        self._cache: Dict[int, np.ndarray] = {
+            i: np.array(self.templates[i], np.float32) for i in self._sparse}
+        self._sp_enc = net.VarFrameEncoder() if self._sparse else None
+        # ids of in-flight sparse pulls, FIFO-aligned with the
+        # ACTION_SPARSE_WEIGHTS entries in _pending (a reconnect re-issues
+        # from here, so it never clears with _pending)
+        self._sparse_pull_ids: Deque[List[np.ndarray]] = deque()
         # per-shard connection of a striped client (ShardedPSClient): every
         # client-side metric/span carries the shard label so the per-shard
         # wall/wire decomposition is readable straight off the registry.
@@ -1728,8 +2181,12 @@ class PSClient:
         # pays nothing.  Entered lock-free: every op releases the lock
         # before its exception reaches _resilient
         with self._io_lock:
-            lost_pulls = sum(1 for kind, _ in self._pending
-                             if kind == net.ACTION_WEIGHTS)
+            # in-flight pulls to re-issue, in wire order; sparse pulls
+            # keep their ids in _sparse_pull_ids (which deliberately does
+            # NOT clear with _pending — it is the re-issue source)
+            lost_kinds = [kind for kind, _ in self._pending
+                          if kind in (net.ACTION_WEIGHTS,
+                                      net.ACTION_SPARSE_WEIGHTS)]
             self._pending.clear()
             try:
                 self.sock.close()
@@ -1769,10 +2226,19 @@ class PSClient:
                     # another budgeted attempt, not escape to the caller —
                     # this runs inside _resilient's except handler, where a
                     # raised exception would NOT be re-caught by its loop
-                    for _ in range(lost_pulls):
-                        self.sock.sendall(self._pull_frame)
-                        self._pending.append((net.ACTION_WEIGHTS,
-                                              time.perf_counter()))
+                    si = 0
+                    for kind in lost_kinds:
+                        if kind == net.ACTION_WEIGHTS:
+                            self.sock.sendall(self._pull_frame)
+                        else:
+                            # re-ask for the SAME rows; the reply observes
+                            # the restarted hub's current center like any
+                            # re-issued pull
+                            self._sp_enc.send(self.sock,
+                                              net.ACTION_SPARSE_PULL,
+                                              self._sparse_pull_ids[si])
+                            si += 1
+                        self._pending.append((kind, time.perf_counter()))
                     self._last_io = time.monotonic()
                     break
                 except (OSError, net.ProtocolError):
@@ -1838,18 +2304,37 @@ class PSClient:
                 pass
 
     # -- pipelined API ---------------------------------------------------------
-    def pull_nowait(self) -> None:
+    def pull_nowait(self, sparse_rows: Optional[Sequence] = None) -> None:
         """Fire a pull request; the reply is consumed later by
         :meth:`wait_weights`.  Issue it while the device computes and the
-        weights' wire time hides under the window."""
+        weights' wire time hides under the window.
+
+        ``sparse_rows`` (sparse-configured clients only): one row-id array
+        per sparse table — the pull moves only those rows (action ``S``),
+        merging them into the client cache on receive.  ``None`` pulls the
+        full center (action ``P``, the pre-sparse byte stream; also
+        re-seeds the caches)."""
         with self._io_lock:
             outstanding = (sum(1 for kind, _ in self._pending
-                               if kind == net.ACTION_WEIGHTS) + len(self._ready))
+                               if kind in (net.ACTION_WEIGHTS,
+                                           net.ACTION_SPARSE_WEIGHTS))
+                           + len(self._ready))
         if outstanding >= 2:
             raise RuntimeError("at most 2 pulls may be outstanding (two "
                                "landing buffers); claim one with "
                                "wait_weights() first")
-        self._resilient(self._pull_nowait_once)
+        if sparse_rows is None:
+            self._resilient(self._pull_nowait_once)
+            return
+        if not self._sparse:
+            raise ValueError("sparse_rows passed to a client with no "
+                             "sparse_leaves configured")
+        if len(sparse_rows) != len(self._sparse):
+            raise ValueError(f"got {len(sparse_rows)} id arrays, client has "
+                             f"{len(self._sparse)} sparse tables")
+        ids_list = [net.normalize_row_ids(ids, self.templates[i].shape[0])
+                    for ids, i in zip(sparse_rows, self._sparse)]
+        self._resilient(lambda: self._sparse_pull_once(ids_list))
 
     def _pull_nowait_once(self) -> None:
         with self._io_lock:
@@ -1857,18 +2342,33 @@ class PSClient:
             self._pending.append((net.ACTION_WEIGHTS, time.perf_counter()))
             self._last_io = time.monotonic()
 
-    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+    def _sparse_pull_once(self, ids_list: List[np.ndarray]) -> None:
+        with self._io_lock:
+            self._sp_enc.send(self.sock, net.ACTION_SPARSE_PULL, ids_list)
+            self._pending.append((net.ACTION_SPARSE_WEIGHTS,
+                                  time.perf_counter()))
+            self._sparse_pull_ids.append(ids_list)
+            self._last_io = time.monotonic()
+
+    def commit_nowait(self, delta: Sequence[np.ndarray],
+                      sparse_rows: Optional[Sequence] = None) -> None:
         """Send a commit without waiting for its ack (coalesced into a later
         receive).  Blocks only when ``max_inflight`` commits are already
-        unacknowledged."""
+        unacknowledged.
+
+        ``sparse_rows`` (sparse-configured clients only): one row-id array
+        per sparse table — the commit carries only those rows' gradients
+        as ``(ids, grads)`` pairs (action ``U``, or ``X`` under int8)."""
         # the span covers the work the client actually does per commit
         # (back-pressure + quantize/pack + send); the ack wait is measured
         # separately by ps.commit_latency_ms when the reply is consumed
         with obs.span("ps.commit", compress=self.compress or "none",
                       **self._mlabels):
-            self._resilient(lambda: self._commit_nowait_once(delta))
+            self._resilient(
+                lambda: self._commit_nowait_once(delta, sparse_rows))
 
-    def _commit_nowait_once(self, delta: Sequence[np.ndarray]) -> None:
+    def _commit_nowait_once(self, delta: Sequence[np.ndarray],
+                            sparse_rows: Optional[Sequence] = None) -> None:
         # deadlock avoidance: never start a potentially-blocking large
         # send while a weights reply may still be in flight — the hub
         # does not read while it writes, so two big sendalls in
@@ -1878,9 +2378,11 @@ class PSClient:
         # hands it out later); the hub is then parked in recv when the
         # commit bytes arrive.  This receive time is pull wire-wait,
         # so it lands in ps.pull_stall_ms like any other pull block.
-        if self._has_pending(net.ACTION_WEIGHTS):
+        if self._has_pending(net.ACTION_WEIGHTS) \
+                or self._has_pending(net.ACTION_SPARSE_WEIGHTS):
             t_drain = time.perf_counter() if obs.enabled() else 0.0
-            while self._has_pending(net.ACTION_WEIGHTS):
+            while (self._has_pending(net.ACTION_WEIGHTS)
+                   or self._has_pending(net.ACTION_SPARSE_WEIGHTS)):
                 self._consume_one()
             if t_drain:
                 obs.histogram("ps.pull_stall_ms", **self._mlabels).observe(
@@ -1889,6 +2391,35 @@ class PSClient:
             self._consume_one()
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
+        if sparse_rows is not None:
+            if not self._sparse:
+                raise ValueError("sparse_rows passed to a client with no "
+                                 "sparse_leaves configured")
+            if len(sparse_rows) != len(self._sparse):
+                # checked BEFORE the zip below, which would truncate
+                raise ValueError(f"got {len(sparse_rows)} id arrays, client "
+                                 f"has {len(self._sparse)} sparse tables")
+            ids_list = [net.normalize_row_ids(ids, self.templates[i].shape[0])
+                        for ids, i in zip(sparse_rows, self._sparse)]
+            arrays = _sparse_commit_arrays(
+                delta, self.templates, self._sparse_set, ids_list,
+                self._residual, self.compress)
+            action = (net.ACTION_SPARSE_QCOMMIT if self.compress == "int8"
+                      else net.ACTION_SPARSE_COMMIT)
+            frame = self._sp_enc.pack(action, arrays)
+            if telemetry:
+                obs.histogram("ps.serialize_ms", **self._mlabels).observe(
+                    (time.perf_counter() - t0) * 1e3)
+                obs.counter("ps.commit_bytes",
+                            **self._mlabels).inc(self._sp_enc.frame_len)
+            with self._io_lock:
+                net.send_raw_frame(self.sock, frame)
+                self._pending.append((net.ACTION_ACK, time.perf_counter()))
+                self._last_io = time.monotonic()
+            if telemetry:
+                obs.gauge("ps.inflight_depth",
+                          **self._mlabels).set(self._unacked())
+            return
         if self.compress == "int8":
             codec, action = self._q_codec, net.ACTION_QCOMMIT
             # safe across a reconnect retry: the residual chain carries
@@ -1991,7 +2522,50 @@ class PSClient:
 
     def _consume_one_inner(self) -> None:
         kind, t_sent = self._pending.popleft()
-        if kind != net.ACTION_WEIGHTS:
+        if kind == net.ACTION_SPARSE_WEIGHTS:
+            # sparse pull reply: dense leaves scatter into the flip
+            # landing buffers exactly like a full pull, row blocks land in
+            # per-pull scratch and merge into the table caches; the
+            # full-order result hands the caches out in the sparse slots
+            ids_list = self._sparse_pull_ids[0]
+            bufs = self._pull_bufs[self._flip]
+            self._flip ^= 1
+            out: List[np.ndarray] = []
+            si = 0
+            for i, t in enumerate(self.templates):
+                if i in self._sparse_set:
+                    out.append(np.empty((ids_list[si].size, t.shape[1]),
+                                        np.float32))
+                    si += 1
+                else:
+                    out.append(bufs[i])
+            try:
+                reply, _ = net.recv_tensors(self.sock, out=out)
+                if reply != net.ACTION_SPARSE_WEIGHTS:
+                    raise ConnectionError(
+                        f"expected sparse weights reply, got {reply!r}")
+            except Exception:
+                self._flip ^= 1
+                self._pending.appendleft((kind, t_sent))
+                raise
+            self._last_io = time.monotonic()
+            self._sparse_pull_ids.popleft()
+            result: List[np.ndarray] = []
+            si = 0
+            for i in range(len(self.templates)):
+                if i in self._sparse_set:
+                    ids = ids_list[si]
+                    if ids.size:
+                        self._cache[i][ids] = out[i]
+                    result.append(self._cache[i])
+                    si += 1
+                else:
+                    result.append(out[i])
+            self._ready.append(result)
+            if obs.enabled():
+                obs.histogram("ps.pull_latency_ms", **self._mlabels).observe(
+                    (time.perf_counter() - t_sent) * 1e3)
+        elif kind != net.ACTION_WEIGHTS:
             # ACTION_ACK (commit) and ACTION_HEALTH (report) both await
             # the same ack byte; only the commit's round trip is a commit
             # latency sample
@@ -2020,6 +2594,11 @@ class PSClient:
                 self._pending.appendleft((kind, t_sent))
                 raise
             self._last_io = time.monotonic()
+            # a full pull re-seeds the sparse caches: the landing buffer
+            # is reused two pulls later, the cache is the stable copy the
+            # sparse exchange merges into
+            for i in self._sparse:
+                self._cache[i][...] = out[i]
             self._ready.append(out)
             if obs.enabled():
                 obs.histogram("ps.pull_latency_ms", **self._mlabels).observe(
@@ -2031,8 +2610,9 @@ class PSClient:
             self.pull_nowait()
             return self.wait_weights()
 
-    def commit(self, delta: Sequence[np.ndarray]) -> None:
-        self.commit_nowait(delta)
+    def commit(self, delta: Sequence[np.ndarray],
+               sparse_rows: Optional[Sequence] = None) -> None:
+        self.commit_nowait(delta, sparse_rows=sparse_rows)
         self.drain()
 
     def close(self) -> None:
@@ -2085,12 +2665,30 @@ class InprocPSClient:
 
     def __init__(self, ps: Any, templates: Sequence[np.ndarray],
                  compress: Optional[str] = None,
-                 trace_context: Optional["dtrace.TraceContext"] = None):
+                 trace_context: Optional["dtrace.TraceContext"] = None,
+                 sparse_leaves: Sequence[int] = ()):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.ps = ps
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
         self.compress = compress
+        # row-sparse tables (ISSUE 9): the inproc client mirrors the
+        # socket client's cache-and-merge behavior over the hub's direct
+        # sparse pair, so sparse runs stay trajectory-identical across
+        # transports (no wire to save here — parity is the point).
+        # Requires a co-located hub exposing pull_sparse_direct (the
+        # unsharded Python hubs); the sharded facade has no sparse direct
+        # pair — the trainer falls back to the dense direct exchange there
+        self._sparse = tuple(sorted({int(i) for i in sparse_leaves}))
+        self._sparse_set = frozenset(self._sparse)
+        self._cache: Dict[int, np.ndarray] = {
+            i: np.array(self.templates[i], np.float32) for i in self._sparse}
+        if self._sparse and not hasattr(ps, "pull_sparse_direct"):
+            raise ValueError(
+                f"sparse_leaves need a hub with a sparse direct pair "
+                f"(pull_sparse_direct/commit_sparse_direct); "
+                f"{type(ps).__name__} has none — use the socket transport "
+                f"or an unsharded Python hub")
         self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
                           if compress else None)
         self._last_pull_clock = 0
@@ -2126,12 +2724,40 @@ class InprocPSClient:
         _health.monitor().maybe_check()
 
     # -- pipelined API (eager) -------------------------------------------------
-    def pull_nowait(self) -> None:
+    def pull_nowait(self, sparse_rows: Optional[Sequence] = None) -> None:
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
-        weights, clock = self.ps.pull_direct()
-        self._last_pull_clock = clock
-        self._pulled = weights
+        if sparse_rows is not None:
+            if not self._sparse:
+                raise ValueError("sparse_rows passed to a client with no "
+                                 "sparse_leaves configured")
+            if len(sparse_rows) != len(self._sparse):
+                raise ValueError(f"got {len(sparse_rows)} id arrays, "
+                                 f"client has {len(self._sparse)} sparse "
+                                 f"tables")
+            ids_list = [net.normalize_row_ids(ids,
+                                              self.templates[i].shape[0])
+                        for ids, i in zip(sparse_rows, self._sparse)]
+            values, clock = self.ps.pull_sparse_direct(ids_list)
+            result: List[np.ndarray] = []
+            si = 0
+            for i, v in enumerate(values):
+                if i in self._sparse_set:
+                    ids = ids_list[si]
+                    if ids.size:
+                        self._cache[i][ids] = v
+                    result.append(self._cache[i])
+                    si += 1
+                else:
+                    result.append(v)
+            self._last_pull_clock = clock
+            self._pulled = result
+        else:
+            weights, clock = self.ps.pull_direct()
+            for i in self._sparse:
+                self._cache[i][...] = weights[i]
+            self._last_pull_clock = clock
+            self._pulled = weights
         if telemetry:
             obs.histogram("ps.pull_latency_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
@@ -2142,12 +2768,33 @@ class InprocPSClient:
         pulled, self._pulled = self._pulled, None
         return pulled
 
-    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+    def commit_nowait(self, delta: Sequence[np.ndarray],
+                      sparse_rows: Optional[Sequence] = None) -> None:
         with obs.span("ps.commit", transport="inproc",
                       compress=self.compress or "none"):
             telemetry = obs.enabled()
             t0 = time.perf_counter() if telemetry else 0.0
-            if self.compress == "int8":
+            if sparse_rows is not None:
+                if not self._sparse:
+                    raise ValueError("sparse_rows passed to a client with "
+                                     "no sparse_leaves configured")
+                if len(sparse_rows) != len(self._sparse):
+                    raise ValueError(f"got {len(sparse_rows)} id arrays, "
+                                     f"client has {len(self._sparse)} "
+                                     f"sparse tables")
+                ids_list = [net.normalize_row_ids(
+                    ids, self.templates[i].shape[0])
+                    for ids, i in zip(sparse_rows, self._sparse)]
+                # same row gather + quantize/residual math as the wire
+                # path, then straight back through the dequantizer — what
+                # the hub would have reconstructed from the U/X frame
+                arrays = _sparse_commit_arrays(
+                    delta, self.templates, self._sparse_set, ids_list,
+                    self._residual, self.compress)
+                parts = _sparse_parts_from_arrays(
+                    arrays, self.templates, self._sparse_set, self.compress)
+                self.ps.commit_sparse_direct(parts, self._last_pull_clock)
+            elif self.compress == "int8":
                 # same quantize + residual advance as the wire path, then
                 # straight back through the dequantizer — what the hub
                 # would have reconstructed from the Q frame
@@ -2155,9 +2802,10 @@ class InprocPSClient:
                 arrays = [net.dequantize_q_blob(memoryview(b), t.size)
                           .reshape(t.shape)
                           for b, t in zip(blobs, self.templates)]
+                self.ps.commit_direct(arrays, self._last_pull_clock)
             else:
                 arrays = [np.asarray(d, np.float32) for d in delta]
-            self.ps.commit_direct(arrays, self._last_pull_clock)
+                self.ps.commit_direct(arrays, self._last_pull_clock)
             if telemetry:
                 obs.histogram("ps.commit_latency_ms").observe(
                     (time.perf_counter() - t0) * 1e3)
@@ -2171,8 +2819,9 @@ class InprocPSClient:
             self.pull_nowait()
             return self.wait_weights()
 
-    def commit(self, delta: Sequence[np.ndarray]) -> None:
-        self.commit_nowait(delta)
+    def commit(self, delta: Sequence[np.ndarray],
+               sparse_rows: Optional[Sequence] = None) -> None:
+        self.commit_nowait(delta, sparse_rows=sparse_rows)
 
     def close(self) -> None:
         pass  # no connection; the hub's lifecycle belongs to the trainer
@@ -2206,41 +2855,90 @@ class ShardPlan:
     SAME plan from the same model, so no plan ever travels on the wire."""
 
     def __init__(self, num_shards: int, assignments: Sequence[Sequence[int]],
-                 shard_bytes: Sequence[int]):
+                 shard_bytes: Sequence[int],
+                 sparse_ranges: Optional[Dict[int, Sequence[Tuple[int, int]]]]
+                 = None):
         self.num_shards = int(num_shards)
         self.assignments: Tuple[Tuple[int, ...], ...] = tuple(
             tuple(int(i) for i in idxs) for idxs in assignments)
         self.shard_bytes: Tuple[int, ...] = tuple(int(b) for b in shard_bytes)
-        self.num_leaves = sum(len(idxs) for idxs in self.assignments)
+        # row-sparse tables (ISSUE 9): leaf index -> one contiguous
+        # (row_lo, row_hi) range per shard.  A sparse leaf appears in
+        # EVERY shard's assignment list (each shard owns its row range of
+        # it), so ``num_leaves`` counts DISTINCT leaves
+        self.sparse_ranges: Dict[int, Tuple[Tuple[int, int], ...]] = {
+            int(k): tuple((int(a), int(b)) for a, b in v)
+            for k, v in (sparse_ranges or {}).items()}
+        if self.sparse_ranges:
+            self.num_leaves = len({i for idxs in self.assignments
+                                   for i in idxs})
+        else:
+            self.num_leaves = sum(len(idxs) for idxs in self.assignments)
+
+    def local_sparse(self, shard: int) -> Tuple[int, ...]:
+        """Positions of the sparse leaves WITHIN shard ``shard``'s leaf
+        list — the per-shard hub/client ``sparse_leaves`` argument."""
+        return tuple(pos for pos, i in enumerate(self.assignments[shard])
+                     if i in self.sparse_ranges)
 
     def split(self, arrays: Sequence[Any]) -> List[List[Any]]:
         """Stripe a full-order leaf list into per-shard sublists (reference
-        slicing, no copies)."""
+        slicing, no copies: sparse leaves contribute their shard's
+        contiguous row-range VIEW)."""
         if len(arrays) != self.num_leaves:
             raise ValueError(f"got {len(arrays)} leaves, plan covers "
                              f"{self.num_leaves}")
-        return [[arrays[i] for i in idxs] for idxs in self.assignments]
+        out: List[List[Any]] = []
+        for s, idxs in enumerate(self.assignments):
+            part: List[Any] = []
+            for i in idxs:
+                rng = self.sparse_ranges.get(i)
+                if rng is None:
+                    part.append(arrays[i])
+                else:
+                    lo, hi = rng[s]
+                    part.append(arrays[i][lo:hi])
+            out.append(part)
+        return out
 
-    def assemble(self, shard_lists: Sequence[Sequence[Any]]) -> List[Any]:
+    def assemble(self, shard_lists: Sequence[Sequence[Any]],
+                 sparse_fill: Optional[Dict[int, Any]] = None) -> List[Any]:
         """Inverse of :meth:`split`: reassemble per-shard sublists into the
-        full-order leaf list — by reference, so the per-shard landing
-        buffers ARE the result's storage (zero-copy reassembly)."""
+        full-order leaf list — by reference for whole leaves, so the
+        per-shard landing buffers ARE the result's storage.  A row-range-
+        split sparse leaf is rebuilt by concatenating its per-shard
+        slices (one copy) — unless ``sparse_fill`` supplies the full
+        array for it (the striped client's full cache, whose row-range
+        views the per-shard slices already wrote into)."""
         out: List[Any] = [None] * self.num_leaves
+        slices: Dict[int, List[Any]] = {i: [] for i in self.sparse_ranges}
         for idxs, vals in zip(self.assignments, shard_lists):
             if len(idxs) != len(vals):
                 raise ValueError(f"shard holds {len(idxs)} leaves, got "
                                  f"{len(vals)} values")
             for i, v in zip(idxs, vals):
-                out[i] = v
+                if i in slices:
+                    slices[i].append(v)
+                else:
+                    out[i] = v
+        for i, parts in slices.items():
+            if sparse_fill is not None and i in sparse_fill:
+                out[i] = sparse_fill[i]
+            else:
+                out[i] = np.concatenate([np.asarray(p) for p in parts],
+                                        axis=0)
         return out
 
     def __repr__(self) -> str:
         return (f"ShardPlan(num_shards={self.num_shards}, "
                 f"leaves={self.num_leaves}, "
-                f"shard_bytes={list(self.shard_bytes)})")
+                f"shard_bytes={list(self.shard_bytes)}"
+                + (f", sparse={sorted(self.sparse_ranges)}"
+                   if self.sparse_ranges else "") + ")")
 
 
-def shard_plan(templates: Sequence[np.ndarray], num_shards: int) -> ShardPlan:
+def shard_plan(templates: Sequence[np.ndarray], num_shards: int,
+               sparse_leaves: Sequence[int] = ()) -> ShardPlan:
     """Deterministic, size-balanced leaf->shard assignment.
 
     Leaves are taken in a CANONICAL order — bytes descending, then dtype,
@@ -2253,33 +2951,75 @@ def shard_plan(templates: Sequence[np.ndarray], num_shards: int) -> ShardPlan:
     identical layout are interchangeable — their mutual order falls back
     to input position, which only ever swaps byte-identical slots).
 
+    ``sparse_leaves`` (ISSUE 9) names row-sparse ``[rows, dim]`` embedding
+    tables: each is split across ALL shards by contiguous row range
+    (near-equal row counts, earlier shards take the remainder), so a
+    table that dwarfs the dense model never lands whole on one shard and
+    sparse row traffic stripes naturally.  Dense leaves are then
+    LPT-balanced over shards pre-loaded with their sparse-range bytes.
+
     ``num_shards=1`` returns the identity plan (all leaves, template
-    order); more shards than leaves is an error — an empty shard would
-    serve zero-tensor frames to no purpose."""
+    order); more shards than leaves (when nothing is sparse) is an error
+    — an empty shard would serve zero-tensor frames to no purpose."""
     n = len(templates)
     num_shards = int(num_shards)
     if num_shards < 1:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
-    if num_shards > n:
+    arrs = [np.asarray(t) for t in templates]
+    sparse = tuple(sorted({int(i) for i in sparse_leaves}))
+    for i in sparse:
+        if not 0 <= i < n:
+            raise ValueError(f"sparse leaf index {i} out of range for "
+                             f"{n} templates")
+        if arrs[i].ndim != 2:
+            raise ValueError(f"sparse leaf {i} must be a [rows, dim] table, "
+                             f"got shape {arrs[i].shape}")
+    if num_shards == 1:
+        return ShardPlan(1, [list(range(n))], [sum(a.nbytes for a in arrs)],
+                         sparse_ranges={i: [(0, arrs[i].shape[0])]
+                                        for i in sparse})
+    if not sparse and num_shards > n:
         raise ValueError(f"num_shards={num_shards} exceeds the model's "
                          f"{n} leaves; every shard must own at least one")
-    arrs = [np.asarray(t) for t in templates]
-    if num_shards == 1:
-        return ShardPlan(1, [list(range(n))], [sum(a.nbytes for a in arrs)])
-    order = sorted(range(n),
+    loads = [0] * num_shards
+    sparse_ranges: Dict[int, List[Tuple[int, int]]] = {}
+    for i in sparse:
+        rows = arrs[i].shape[0]
+        if rows < num_shards:
+            raise ValueError(f"sparse leaf {i} has {rows} rows < "
+                             f"num_shards={num_shards}; every shard must "
+                             f"own at least one row")
+        row_bytes = arrs[i].nbytes // rows
+        base, rem = divmod(rows, num_shards)
+        bounds: List[Tuple[int, int]] = []
+        lo = 0
+        for s in range(num_shards):
+            hi = lo + base + (1 if s < rem else 0)
+            bounds.append((lo, hi))
+            loads[s] += (hi - lo) * row_bytes
+            lo = hi
+        sparse_ranges[i] = bounds
+    dense = [i for i in range(n) if i not in set(sparse)]
+    order = sorted(dense,
                    key=lambda i: (-arrs[i].nbytes, str(arrs[i].dtype),
                                   arrs[i].shape, i))
-    heap = [(0, s) for s in range(num_shards)]  # (bytes, shard id)
+    heap = [(loads[s], s) for s in range(num_shards)]  # (bytes, shard id)
     heapq.heapify(heap)
-    assignments: List[List[int]] = [[] for _ in range(num_shards)]
+    assignments: List[List[int]] = [list(sparse) for _ in range(num_shards)]
     for i in order:
         filled, s = heapq.heappop(heap)
         assignments[s].append(i)
         heapq.heappush(heap, (filled + arrs[i].nbytes, s))
     for idxs in assignments:
         idxs.sort()
-    shard_bytes = [sum(arrs[i].nbytes for i in idxs) for idxs in assignments]
-    return ShardPlan(num_shards, assignments, shard_bytes)
+    shard_bytes = [
+        sum((sparse_ranges[i][s][1] - sparse_ranges[i][s][0])
+            * (arrs[i].nbytes // arrs[i].shape[0]) if i in sparse_ranges
+            else arrs[i].nbytes
+            for i in idxs)
+        for s, idxs in enumerate(assignments)]
+    return ShardPlan(num_shards, assignments, shard_bytes,
+                     sparse_ranges=sparse_ranges)
 
 
 class SnapshotSetCoordinator:
@@ -2673,7 +3413,8 @@ class ShardedPSClient:
                  reconnect_backoff_max: float = 5.0,
                  heartbeat_interval: Optional[float] = None,
                  trace_context: Optional["dtrace.TraceContext"] = None,
-                 failover: Optional[Sequence[Any]] = None):
+                 failover: Optional[Sequence[Any]] = None,
+                 sparse_leaves: Sequence[int] = ()):
         if len(addresses) != plan.num_shards:
             raise ValueError(f"got {len(addresses)} shard addresses, plan "
                              f"has {plan.num_shards} shards")
@@ -2687,12 +3428,27 @@ class ShardedPSClient:
                              f"has {len(self.templates)}")
         self.plan = plan
         self.compress = compress
+        # row-sparse tables (ISSUE 9): the plan splits each table across
+        # ALL shards by contiguous row range; this client keeps ONE
+        # full-size cache per table and hands each per-shard client its
+        # row-range VIEW of it as that shard's local cache — so per-shard
+        # sparse merges write straight into the full table, and
+        # wait_weights reassembles with zero row copies
+        self._sparse = tuple(sorted({int(i) for i in sparse_leaves}))
+        if self._sparse and set(self._sparse) != set(plan.sparse_ranges):
+            raise ValueError(
+                f"sparse_leaves {list(self._sparse)} do not match the "
+                f"plan's sparse tables {sorted(plan.sparse_ranges)}; build "
+                f"the plan with shard_plan(..., sparse_leaves=...)")
+        self._cache: Dict[int, np.ndarray] = {
+            i: np.array(self.templates[i], np.float32) for i in self._sparse}
         self.shards: List[PSClient] = []
         try:
+            local_templates = plan.split(self.templates)
             for sid, ((host, port), idxs) in enumerate(
                     zip(addresses, plan.assignments)):
-                self.shards.append(PSClient(
-                    host, port, [self.templates[i] for i in idxs],
+                client = PSClient(
+                    host, port, local_templates[sid],
                     timeout=timeout, compress=compress,
                     max_inflight=max_inflight,
                     max_reconnects=max_reconnects,
@@ -2700,8 +3456,20 @@ class ShardedPSClient:
                     reconnect_backoff_max=reconnect_backoff_max,
                     heartbeat_interval=heartbeat_interval,
                     trace_context=trace_context, shard_id=sid,
+                    sparse_leaves=plan.local_sparse(sid)
+                    if self._sparse else (),
                     failover=_normalize_failover(
-                        failover[sid] if failover is not None else None)))
+                        failover[sid] if failover is not None else None))
+                # rebind the shard client's caches to row-range views of
+                # the full tables (contiguous slices, so fancy-indexed
+                # merges land in the full cache directly)
+                if self._sparse:
+                    for pos, i in zip(plan.local_sparse(sid),
+                                      (j for j in idxs
+                                       if j in plan.sparse_ranges)):
+                        lo, hi = plan.sparse_ranges[i][sid]
+                        client._cache[pos] = self._cache[i][lo:hi]
+                self.shards.append(client)
         except BaseException:
             self.close()
             raise
@@ -2731,23 +3499,63 @@ class ShardedPSClient:
                     address=f"{client.host}:{client.port}", **wattrs)
             raise StripeLostError(sid, client.host, client.port, e) from e
 
+    def _route_rows(self, sparse_rows: Sequence) -> List[List[np.ndarray]]:
+        """Route each table's touched-row ids to the shard owning their
+        row range (ids are sorted, so each shard's segment is one
+        ``searchsorted`` slice), rebased to the shard's local row 0."""
+        if len(sparse_rows) != len(self._sparse):
+            # checked BEFORE the zip below, which would truncate
+            raise ValueError(f"got {len(sparse_rows)} id arrays, client has "
+                             f"{len(self._sparse)} sparse tables")
+        ids_list = [net.normalize_row_ids(ids, self.templates[i].shape[0])
+                    for ids, i in zip(sparse_rows, self._sparse)]
+        per_shard: List[List[np.ndarray]] = []
+        for sid in range(self.plan.num_shards):
+            local: List[np.ndarray] = []
+            for pos, i in enumerate(self._sparse):
+                lo, hi = self.plan.sparse_ranges[i][sid]
+                ids = ids_list[pos]
+                a, b = np.searchsorted(ids, (lo, hi))
+                local.append(ids[a:b] - lo)
+            per_shard.append(local)
+        return per_shard
+
     # -- pipelined API ---------------------------------------------------------
-    def pull_nowait(self) -> None:
-        for sid, client in enumerate(self.shards):
-            self._stripe(sid, client.pull_nowait)
+    def pull_nowait(self, sparse_rows: Optional[Sequence] = None) -> None:
+        if sparse_rows is None:
+            for sid, client in enumerate(self.shards):
+                self._stripe(sid, client.pull_nowait)
+            return
+        if not self._sparse:
+            raise ValueError("sparse_rows passed to a client with no "
+                             "sparse_leaves configured")
+        for sid, (client, local) in enumerate(
+                zip(self.shards, self._route_rows(sparse_rows))):
+            self._stripe(sid, lambda c=client, l=local:
+                         c.pull_nowait(sparse_rows=l))
 
     def wait_weights(self) -> List[np.ndarray]:
-        """Full-order weight list; each leaf aliases its shard client's
-        landing buffer (reused two pulls later — same ownership contract
-        as :meth:`PSClient.wait_weights`)."""
+        """Full-order weight list; each dense leaf aliases its shard
+        client's landing buffer (reused two pulls later — same ownership
+        contract as :meth:`PSClient.wait_weights`); each sparse table is
+        the client's full cache (stable storage, merged in place)."""
+        parts = [self._stripe(sid, c.wait_weights)
+                 for sid, c in enumerate(self.shards)]
         return self.plan.assemble(
-            [self._stripe(sid, c.wait_weights)
-             for sid, c in enumerate(self.shards)])
+            parts, sparse_fill=self._cache if self._sparse else None)
 
-    def commit_nowait(self, delta: Sequence[np.ndarray]) -> None:
+    def commit_nowait(self, delta: Sequence[np.ndarray],
+                      sparse_rows: Optional[Sequence] = None) -> None:
+        if sparse_rows is not None and not self._sparse:
+            raise ValueError("sparse_rows passed to a client with no "
+                             "sparse_leaves configured")
+        routed = (self._route_rows(sparse_rows)
+                  if sparse_rows is not None else None)
         for sid, (client, part) in enumerate(
                 zip(self.shards, self.plan.split(list(delta)))):
-            self._stripe(sid, lambda c=client, p=part: c.commit_nowait(p))
+            local = routed[sid] if routed is not None else None
+            self._stripe(sid, lambda c=client, p=part, l=local:
+                         c.commit_nowait(p, sparse_rows=l))
 
     def drain(self) -> None:
         for sid, client in enumerate(self.shards):
@@ -2775,8 +3583,9 @@ class ShardedPSClient:
             self.pull_nowait()
             return self.wait_weights()
 
-    def commit(self, delta: Sequence[np.ndarray]) -> None:
-        self.commit_nowait(delta)
+    def commit(self, delta: Sequence[np.ndarray],
+               sparse_rows: Optional[Sequence] = None) -> None:
+        self.commit_nowait(delta, sparse_rows=sparse_rows)
         self.drain()
 
     def close(self) -> None:

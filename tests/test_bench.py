@@ -428,6 +428,63 @@ def test_health_acceptance_block_tripwires():
     assert acc3["reports_ok"] is None
 
 
+def test_embedding_acceptance_block_tripwires():
+    """The issue-9 tripwire block: sparse exchange bytes under
+    1.1 x touched-row fraction of the dense leg, rows/s recorded — with
+    None (not a crash) wherever a leg is missing (PR-3 convention)."""
+    out = {
+        "dense": {"wall_s": 1.0, "wire_bytes": 110_000_000,
+                  "exchange_bytes": 100_000_000},
+        "sparse": {"wall_s": 0.5, "wire_bytes": 2_000_000,
+                   "exchange_bytes": 1_000_000, "rows_per_s": 5000.0,
+                   "touched_row_fraction": 0.01},
+    }
+    bench._embedding_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["wire_ratio"] == 0.01
+    assert acc["wire_ratio_bound"] == 0.011
+    assert acc["sparse_wire_ok"] is True
+    assert acc["rows_per_s_recorded"] is True
+
+    out2 = {
+        "dense": {"exchange_bytes": 100_000_000},
+        "sparse": {"exchange_bytes": 2_000_000, "rows_per_s": 5000.0,
+                   "touched_row_fraction": 0.01},
+    }
+    bench._embedding_acceptance(out2)
+    assert out2["acceptance"]["sparse_wire_ok"] is False  # 0.02 > 0.011
+
+    out3 = {"dense": {"error": "boom"}}  # sparse leg never ran
+    bench._embedding_acceptance(out3)
+    acc3 = out3["acceptance"]
+    assert acc3["sparse_wire_ok"] is None
+    assert acc3["wire_ratio"] is None
+    assert acc3["rows_per_s_recorded"] is None
+
+    out4 = {}  # the whole leg errored before measuring anything
+    bench._embedding_acceptance(out4)
+    assert out4["acceptance"]["sparse_wire_ok"] is None
+
+
+@pytest.mark.slow  # ~60-200s of real bench machinery on CPU
+def test_embedding_bench_runs_tiny():
+    """End-to-end smoke of the issue-9 leg at toy scale: both legs run,
+    the tripwire block attaches, the sparse leg actually moved fewer
+    exchange bytes than the dense leg and counted its rows.  (The toy
+    shape's dense head is NOT negligible next to the toy table, so the
+    1.1x bound itself is asserted only at the real bench shape.)"""
+    out = bench._bench_embedding(rows=2048, dim=32, fields=2, batch=8,
+                                 window=2, windows_per_epoch=2, epochs=1,
+                                 workers=1, reps=1)
+    assert "acceptance" in out
+    assert out["dense"]["exchange_bytes"] > 0
+    assert out["sparse"]["exchange_bytes"] > 0
+    assert out["sparse"]["exchange_bytes"] < out["dense"]["exchange_bytes"]
+    assert out["sparse"]["rows_committed"] > 0
+    assert out["acceptance"]["rows_per_s_recorded"] is True
+    assert out["acceptance"]["wire_ratio"] is not None
+
+
 @pytest.mark.slow  # ~60-200s of real bench machinery on CPU
 def test_health_bench_runs_tiny():
     """End-to-end smoke of the issue-8 leg at toy scale: both sub-legs

@@ -367,6 +367,9 @@ def test_client_failover_zero_acked_commit_loss():
     leaves); the in-flight unacked commit may drop (PR-4 semantics)."""
     primary, replica = _replica_pair(retries=2, backoff=0.02)
     try:
+        # same deterministic gate as the telemetry drill below: the kill
+        # must not race the standby's initial attach+sync
+        assert replica.wait_synced(timeout=10)
         with PSClient("127.0.0.1", primary.port, templates=_weights(),
                       failover=[("127.0.0.1", replica.port)],
                       max_reconnects=6, reconnect_backoff=0.02) as c:
@@ -400,10 +403,19 @@ def test_failover_telemetry_and_fleet_report():
     obs.enable()
     obs.reset()
     try:
+        # deterministic promotion gate (the PR 8 drill-ordering rule):
+        # kill ONLY once the standby has (a) applied its full sync and
+        # (b) seen the first commit replicate.  Killing earlier races the
+        # replica's initial attach — under full-suite load the standby
+        # could still be dialing a primary that is already dead, never
+        # sync, and (correctly) refuse to promote forever, so the whole
+        # drill came down to thread-scheduling luck (~1-in-10 timeouts)
+        assert replica.wait_synced(timeout=10)
         with PSClient("127.0.0.1", primary.port, templates=_weights(),
                       failover=[("127.0.0.1", replica.port)],
                       max_reconnects=6, reconnect_backoff=0.02) as c:
             c.commit(_ones())
+            assert _wait_until(lambda: replica._clock >= 1)
             primary.kill()
             c.commit(_ones())
             c.commit(_ones())

@@ -831,13 +831,15 @@ def _ast_unused_imports(path):
     return {name: line for name, line in imported.items() if name not in used}
 
 
-@pytest.mark.parametrize("package", ["observability", "runtime", ".", "tests"])
+@pytest.mark.parametrize("package", ["observability", "runtime", ".", "tests",
+                                     "data", "parallel", "models", "ops"])
 def test_package_is_lint_clean(package):
     """Satellite (PR 5, extended to runtime/ by PR 6, to the package's
     top-level modules — checkpoint.py, utils.py, trainers.py, ... — by
-    PR 7, and to ``tests/`` itself by PR 8): ruff-clean check scoped to
-    the instrumented packages.  Runs real ruff when the container has it;
-    otherwise falls back to an AST unused-import (F401) sweep plus a
+    PR 7, to ``tests/`` itself by PR 8, and to the remaining packages —
+    data/, parallel/, models/, ops/ — by PR 9): ruff-clean check scoped
+    to the instrumented packages.  Runs real ruff when the container has
+    it; otherwise falls back to an AST unused-import (F401) sweep plus a
     compile check.  ``"."`` scans the ``distkeras_tpu/*.py`` files
     themselves (non-recursive; the subpackages have their own
     parametrized cells); ``"tests"`` scans this directory."""
