@@ -1905,6 +1905,11 @@ def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256
     except Exception as ex:
         out["snapshot_barrier"] = {"error": f"{type(ex).__name__}: {ex}"}
 
+    try:
+        out["adaptive"] = _bench_async_adaptive()
+    except Exception as ex:
+        out["adaptive"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     _async_recovery_acceptance(out)
     return out
 
@@ -1972,6 +1977,114 @@ def _bench_snapshot_barrier(*, shards: int = 4, min_wall_s: float = 1.0,
     }
 
 
+def _bench_async_adaptive(*, workers: int = 8, window: int = 4,
+                          batch: int = 64, windows_per_epoch: int = 4,
+                          epochs: int = 2,
+                          jitter_s=(0.02, 0.06), seed: int = 11):
+    """Issue-10 adaptive leg: at ``workers`` workers with ONE
+    ChaosProxy-throttled straggler (the whole fleet fronts one proxy;
+    seeded jitter applies to conn 0 only), does ``adaptive=True`` beat
+    plain ADAG's final loss at comparable wall time?
+
+    Both legs run the IDENTICAL workload, model seed, proxy seed and
+    telemetry (health reports every 0.25 s, detectors on a fast drill
+    cadence) — the only difference is the knob, so the delta is the
+    control loop's: Adasum merging of queued commits, DynSGD-style
+    per-worker scales from the live staleness series, and storm
+    backpressure.  Cold timing per leg (each leg compiles its own
+    trainer); the tripwire therefore compares LOSS at a bounded wall
+    RATIO rather than raw walls."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.observability import health as health_mod
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+    from distkeras_tpu.runtime.faults import ChaosProxy
+    from distkeras_tpu.runtime.launcher import start_parameter_server
+
+    spec = ModelSpec(name="mlp",
+                     config={"hidden_sizes": (32,), "num_outputs": 10},
+                     input_shape=(16,))
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)]
+    ds = Dataset({"features": x, "label": y})
+    kwargs = dict(loss="categorical_crossentropy", batch_size=batch,
+                  num_epoch=epochs, learning_rate=0.05, seed=0,
+                  num_workers=workers, communication_window=window)
+    out = {"workers": workers, "window": window, "batch": batch,
+           "epochs": epochs, "jitter_s": list(jitter_s), "seed": seed}
+
+    for name, adaptive in (("plain", False), ("adaptive", True)):
+        try:
+            health_mod.reset_default()
+            mon = health_mod.monitor()
+            # drill cadence: the run is seconds long, the default 2 s
+            # check / 10 s cooldown would let it end before reacting
+            # (restored in the finally — the process monitor outlives
+            # this leg)
+            old_cadence = (mon.check_interval_s, mon.cooldown_s)
+            mon.check_interval_s = 0.2
+            mon.cooldown_s = 0.5
+            model0 = Model.init(spec, seed=0)
+            ps = proxy = None
+            try:
+                # hub and proxy start INSIDE the try: a bind failure must
+                # still stop whatever came up and restore the cadence, or
+                # the leak contaminates the second leg
+                ps = start_parameter_server(model0, mode="adag",
+                                            num_workers=workers,
+                                            idle_timeout=None,
+                                            adaptive=adaptive)
+                proxy = ChaosProxy("127.0.0.1", ps.port,
+                                   jitter_delay_s=tuple(jitter_s),
+                                   seed=seed, slow_conns={0}).start()
+                tr = AsyncADAG(Model.init(spec, seed=0),
+                               ps_address=("127.0.0.1", proxy.port),
+                               adaptive=adaptive, health_interval_s=0.25,
+                               max_reconnects=8, reconnect_backoff=0.05,
+                               **kwargs)
+                obs.enable()
+                obs.reset()
+                try:
+                    t0 = time.perf_counter()
+                    tr.train(ds, shuffle=False)
+                    wall = time.perf_counter() - t0
+                    snap = obs.snapshot()
+                    events = [e["kind"] for e in mon.events()]
+                finally:
+                    obs.reset()
+                    obs.disable()
+            finally:
+                if proxy is not None:
+                    proxy.stop()
+                if ps is not None:
+                    ps.stop()
+                mon.check_interval_s, mon.cooldown_s = old_cadence
+                health_mod.reset_default()
+            counters = snap.get("counters", {})
+            loss = (round(float(np.mean(tr.history[-8:])), 6)
+                    if tr.history else None)
+            out[name] = {
+                "timing": "cold-wall (each leg compiles its own trainer)",
+                "wall_s": round(wall, 3),
+                "final_loss": loss,
+                "merged_commits": counters.get("ps_merged_commits_total",
+                                               0.0),
+                "rate_scaled_commits": counters.get(
+                    "ps_rate_scaled_commits_total", 0.0),
+                "backpressure_hints": counters.get(
+                    "ps_backpressure_hints_total", 0.0),
+                "events": sorted(set(events)),
+            }
+        except Exception as ex:
+            out[name] = {"error": f"{type(ex).__name__}: {ex}"}
+    return out
+
+
 def _async_recovery_acceptance(out: dict) -> None:
     """Attach the issue-4 recovery tripwires, in place.  Booleans, or None
     when a denominator leg is missing/errored (graceful degradation,
@@ -1998,6 +2111,36 @@ def _async_recovery_acceptance(out: dict) -> None:
     barrier_pct = (barrier.get("overhead_pct")
                    if isinstance(barrier, dict) and "error" not in barrier
                    else None)
+    # issue-10 adaptive leg: adaptive vs plain ADAG with one throttled
+    # straggler — loss must not be worse at comparable wall, and the
+    # control loop must have visibly REACTED (merged or rate-scaled at
+    # least one commit); None-degrading like every other leg
+    ad = out.get("adaptive", {})
+
+    def _leg(name):
+        leg = ad.get(name) if isinstance(ad, dict) else None
+        return (leg if isinstance(leg, dict) and "error" not in leg
+                else None)
+
+    ad_plain, ad_adap = _leg("plain"), _leg("adaptive")
+    ad_ratio = None
+    ad_beats = None
+    ad_reacted = None
+    if ad_plain is not None and ad_adap is not None:
+        p_loss, a_loss = ad_plain.get("final_loss"), ad_adap.get("final_loss")
+        p_wall, a_wall = ad_plain.get("wall_s"), ad_adap.get("wall_s")
+        if p_wall:
+            ad_ratio = round(a_wall / p_wall, 3)
+        if p_loss is not None and a_loss is not None and ad_ratio is not None:
+            # "beats at equal wall time": both legs run the same windows,
+            # so equal-work walls must stay comparable (<= 1.25x) and the
+            # adaptive loss must land at or below plain (small slack for
+            # run-to-run float noise)
+            ad_beats = bool(a_loss <= p_loss + 0.01 * max(1.0, abs(p_loss))
+                            and ad_ratio <= 1.25)
+    if ad_adap is not None:
+        ad_reacted = bool((ad_adap.get("merged_commits") or 0)
+                          + (ad_adap.get("rate_scaled_commits") or 0) >= 1)
     out["acceptance"] = {
         "sever_recovered_ok": (bool(out["sever"]["faults_fired"] >= 1
                                     and out["sever"]["reconnects"] >= 1)
@@ -2032,6 +2175,13 @@ def _async_recovery_acceptance(out: dict) -> None:
         "snapshot_barrier_overhead_pct": barrier_pct,
         "snapshot_barrier_ok": (None if barrier_pct is None
                                 else bool(barrier_pct < 5.0)),
+        "adaptive_plain_final_loss": (ad_plain.get("final_loss")
+                                      if ad_plain else None),
+        "adaptive_final_loss": (ad_adap.get("final_loss")
+                                if ad_adap else None),
+        "adaptive_wall_ratio": ad_ratio,
+        "adaptive_beats_plain_ok": ad_beats,
+        "adaptive_reacted_ok": ad_reacted,
     }
 
 

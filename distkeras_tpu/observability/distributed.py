@@ -516,10 +516,37 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             live_snap = None  # a half-built collector degrades to span-only
         if live_snap is not None:
             report["live"] = live_snap
+            adaptive = _adaptive_block(live_snap)
+            if adaptive is not None:
+                report["adaptive"] = adaptive
     report["coverage"] = _report_coverage(
         len(spans), window_spans, commits_total, commits_with_ctx,
         workers, live_snap)
     return report
+
+
+def _adaptive_block(live_snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """ISSUE 10: the adaptive hub's live state pulled out of the
+    collector snapshot into one block — per-worker APPLIED commit scale
+    (the rate controller's multiplicative factor, 1.0 = unscaled) and
+    the hub pseudo-workers' merge-queue batch depth.  ``None`` when the
+    run carries no adaptive series at all (``adaptive=False``), so
+    non-adaptive reports stay byte-identical."""
+    workers = live_snap.get("workers") or {}
+    scales: Dict[str, Any] = {}
+    merge: Dict[str, Any] = {}
+    for w, entry in workers.items():
+        metrics = entry.get("metrics") or {}
+        s = metrics.get("adaptive_scale")
+        if s and s.get("n"):
+            scales[w] = {"last": s.get("last"), "mean": s.get("mean")}
+        q = metrics.get("merge_queue_depth")
+        if q and q.get("n"):
+            merge[w] = {"last": q.get("last"), "mean": q.get("mean"),
+                        "p95": q.get("p95")}
+    if not scales and not merge:
+        return None
+    return {"active": True, "worker_scales": scales, "merge_queue": merge}
 
 
 def _report_coverage(n_spans: int, window_spans: int, commits_total: int,

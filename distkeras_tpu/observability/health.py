@@ -236,6 +236,12 @@ class HealthMonitor:
     - **staleness_spike**: a worker's latest staleness exceeds
       ``staleness_factor``x its rolling median baseline AND the absolute
       floor ``staleness_min`` (small-number noise must not page anyone).
+    - **staleness_drift** (ISSUE 10): a worker's ROLLING MEAN staleness
+      exceeds ``drift_factor``x the fleet median mean (same
+      ``min_fleet``/``min_samples``/``staleness_min`` gates).  The spike
+      detector compares a worker to its OWN baseline, so a worker that
+      is ALWAYS behind never spikes — this fleet-relative form is the
+      signal the adaptive hub's DynSGD-style rate scaling keys off.
     - **reconnect_storm** / **failover_storm**: ``reconnects_total`` /
       ``failovers_total`` grew by >= ``storm_threshold`` within the
       window.
@@ -255,6 +261,7 @@ class HealthMonitor:
                  min_samples: int = 3,
                  staleness_factor: float = 3.0,
                  staleness_min: float = 4.0,
+                 drift_factor: float = 2.0,
                  storm_threshold: int = 3,
                  lag_growth_factor: float = 2.0,
                  lag_min: float = 8.0,
@@ -269,6 +276,7 @@ class HealthMonitor:
         self.min_samples = int(min_samples)
         self.staleness_factor = float(staleness_factor)
         self.staleness_min = float(staleness_min)
+        self.drift_factor = float(drift_factor)
         self.storm_threshold = int(storm_threshold)
         self.lag_growth_factor = float(lag_growth_factor)
         self.lag_min = float(lag_min)
@@ -278,6 +286,7 @@ class HealthMonitor:
         self.jsonl_path = jsonl_path
         self._lock = threading.Lock()
         self._events: "deque[HealthEvent]" = deque(maxlen=int(capacity))
+        self._subs: List[Any] = []
         self._last_fired: Dict[Any, float] = {}
         self._last_check = 0.0
         # run-start throughput baseline: EWMA over the first
@@ -321,9 +330,38 @@ class HealthMonitor:
         self._record(event)
         return event
 
+    def subscribe(self, callback: Any) -> Any:
+        """Register ``callback(event)`` to run on EVERY event recorded
+        through this monitor — detector firings and :meth:`emit` alike.
+        This is the push hook reactive components attach to instead of
+        polling :meth:`events` (ISSUE 10: the adaptive hub's per-worker
+        rate controller and storm backpressure).  Callbacks run on the
+        thread that recorded the event, outside the monitor lock;
+        exceptions are swallowed — a broken subscriber must never take
+        down detection or the path that emitted.  Returns ``callback``
+        as the :meth:`unsubscribe` handle.  Subscriptions survive
+        :meth:`clear` (a run-boundary reset must not silently unhook a
+        live hub)."""
+        with self._lock:
+            self._subs.append(callback)
+        return callback
+
+    def unsubscribe(self, callback: Any) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(callback)
+            except ValueError:
+                pass
+
     def _record(self, event: HealthEvent) -> None:
         with self._lock:
             self._events.append(event)
+            subs = list(self._subs)
+        for cb in subs:
+            try:
+                cb(event)
+            except Exception:
+                pass  # a broken subscriber must not break the pipeline
         # into the span ring: the PR-5 trace pipeline (flush, merge,
         # fleet_report) carries health events like any other span.  Lazy
         # import keeps this module import-light for the punchcard daemon
@@ -376,6 +414,7 @@ class HealthMonitor:
         now = time.monotonic() if now is None else float(now)
         fired: List[HealthEvent] = []
         for detect in (self._detect_stragglers, self._detect_staleness,
+                       self._detect_staleness_drift,
                        self._detect_storms, self._detect_replication_lag,
                        self._detect_throughput):
             try:
@@ -434,6 +473,32 @@ class HealthMonitor:
                 ev = self.emit("staleness_spike", "warning", worker=w,
                                shard=self._shard_of(w),
                                staleness=last, baseline=baseline)
+                if ev is not None:
+                    fired.append(ev)
+        return fired
+
+    def _detect_staleness_drift(self, now: float) -> List[HealthEvent]:
+        """Persistent-straggler staleness (ISSUE 10): fleet-relative
+        rolling means, so a worker that is ALWAYS behind — invisible to
+        the spike detector, whose baseline is the worker's own history —
+        still names itself.  The event's evidence carries exactly what
+        the adaptive hub's DynSGD-style rate rule needs."""
+        means = {}
+        for w, s in self._worker_series("staleness").items():
+            if len(s.samples(now)) >= self.min_samples:
+                means[w] = s.mean(now)
+        if len(means) < self.min_fleet:
+            return []
+        ordered = sorted(means.values())
+        median = ordered[len(ordered) // 2]
+        fired = []
+        for w, m in means.items():
+            if m >= self.staleness_min \
+                    and m > self.drift_factor * max(median, 1.0):
+                ev = self.emit("staleness_drift", "warning", worker=w,
+                               shard=self._shard_of(w),
+                               staleness_mean=round(m, 2),
+                               fleet_median=round(median, 2))
                 if ev is not None:
                     fired.append(ev)
         return fired
@@ -582,7 +647,8 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         f"distkeras-top — {len(workers)} worker(s), "
         f"{len(events)} event(s)  [{time.strftime('%H:%M:%S')}]",
         f"{'WORKER':>8} {'SHARD':>5} {'WIN/S':>7} {'WALL MS':>9} "
-        f"{'P95 MS':>9} {'STALE':>6} {'RECON':>6} {'ROW/S':>8} {'AGE S':>6}",
+        f"{'P95 MS':>9} {'STALE':>6} {'SCALE':>6} {'RECON':>6} "
+        f"{'ROW/S':>8} {'MQ':>4} {'AGE S':>6}",
     ]
 
     def sort_key(item):
@@ -600,12 +666,20 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         # the worker's cumulative sparse_rows_total series; "-" for
         # workers (or whole fleets) that move dense leaves only
         sparse = m.get("sparse_rows_total") or {}
+        # adaptive aggregation (ISSUE 10): the hub-applied per-worker
+        # commit scale (workers) and the merge-queue batch depth (the
+        # hub pseudo-worker rows); "-" when the hub is not adaptive
+        scale = m.get("adaptive_scale") or {}
+        mq = m.get("merge_queue_depth") or {}
         lines.append(
             f"{w:>8} {_fmt(meta.get('shard')):>5} "
             f"{_fmt(windows.get('rate'), 2):>7} "
             f"{_fmt(wall.get('mean')):>9} {_fmt(wall.get('p95')):>9} "
-            f"{_fmt(stale.get('last'), 0):>6} {_fmt(recon.get('last'), 0):>6} "
+            f"{_fmt(stale.get('last'), 0):>6} "
+            f"{_fmt(scale.get('last'), 2):>6} "
+            f"{_fmt(recon.get('last'), 0):>6} "
             f"{_fmt(sparse.get('rate'), 0):>8} "
+            f"{_fmt(mq.get('last'), 0):>4} "
             f"{_fmt(meta.get('age_s')):>6}")
     if events:
         lines.append("recent events:")

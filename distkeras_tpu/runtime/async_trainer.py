@@ -127,6 +127,7 @@ class AsyncDistributedTrainer(Trainer):
                  trace_context: Optional[str] = None,
                  health_interval_s: Optional[float] = None,
                  sparse_tables: Optional[Any] = None,
+                 adaptive: bool = False,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -337,6 +338,22 @@ class AsyncDistributedTrainer(Trainer):
                     "False): the C++ hub has no sparse pull/commit "
                     "handlers — drop native_ps, or drop sparse_tables to "
                     "move full leaves")
+        # telemetry-driven adaptive aggregation (ISSUE 10), off by
+        # default.  On: the trainer-owned hub merges queued commits
+        # Adasum-style, scales each worker's commits by its live
+        # staleness standing (DynSGD re-based on the fleet, driven by
+        # HealthMonitor events), and sheds reconnect storms with
+        # retry-after hints the workers' clients honor (wire action G/Y
+        # — opt-in, every pre-existing frame unchanged).  Workers get
+        # trace contexts even with telemetry off, so the hub can
+        # attribute staleness per worker; pair with health_interval_s
+        # for window-wall straggler detection too.  Python hub only
+        self.adaptive = bool(adaptive)
+        if self.adaptive and native_ps:
+            raise ValueError(
+                "adaptive=True requires the Python hub (native_ps=False): "
+                "the C++ hub has no adaptive combiner or backpressure "
+                "handlers — drop native_ps, or drop adaptive")
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
@@ -365,6 +382,11 @@ class AsyncDistributedTrainer(Trainer):
         sp = getattr(self, "_hub_sparse", None)
         if sp is not None:
             kw["sparse_leaves"] = sp.get(shard_id, ())
+        if self.adaptive:
+            # only added when on, so the C++ hub's ctor (no such kwarg)
+            # stays reachable on the default path (and the native_ps +
+            # adaptive combination is already rejected at setup)
+            kw["adaptive"] = True
         return kw
 
     def _resolve_sparse_tables(self, flat: List[np.ndarray]) -> Tuple[int, ...]:
@@ -537,12 +559,14 @@ class AsyncDistributedTrainer(Trainer):
             ps = None
             addresses = list(self._ps_addresses)
         else:
-            if self.health_interval_s is not None:
+            if self.health_interval_s is not None or self.adaptive:
                 # we own the hub, so the process-default collector/monitor
                 # serve THIS run: drop the previous run's series and frozen
                 # throughput baseline, or run 2's ramp-up reads as a
                 # regression against run 1's steady state (remote hubs are
-                # long-lived and multi-job; only the owner resets)
+                # long-lived and multi-job; only the owner resets).  An
+                # adaptive hub subscribes to this monitor at start(), so
+                # the reset must come first
                 from distkeras_tpu.observability import health as _health
                 _health.reset_default()
             ps = self._allocate_hub(flat_f32, plan)
@@ -590,9 +614,12 @@ class AsyncDistributedTrainer(Trainer):
         # (explicit trace_context joins multi-host workers under one job).
         # Resolved once here so a restarted worker keeps the job identity.
         # The process clock-sync estimate resets per run: an offset
-        # measured against a PREVIOUS run's hub must not outlive it
+        # measured against a PREVIOUS run's hub must not outlive it.
+        # Adaptive runs create contexts even with telemetry off: the
+        # hub's per-worker staleness series (what the rate controller
+        # scales from) are keyed by the announced worker identity
         trace_job = ((self.trace_context or dtrace.new_job_id())
-                     if obs.enabled() else None)
+                     if obs.enabled() or self.adaptive else None)
         if trace_job is not None:
             dtrace.reset_clock_sync()
             if os.environ.get("DKT_TRACE_DIR"):
@@ -670,7 +697,8 @@ class AsyncDistributedTrainer(Trainer):
                                          heartbeat_interval=self.heartbeat_interval,
                                          trace_context=ctx,
                                          failover=self._ps_failover,
-                                         sparse_leaves=sparse_idx)
+                                         sparse_leaves=sparse_idx,
+                                         adaptive=self.adaptive)
             else:
                 client = PSClient(addresses[0][0], addresses[0][1],
                                   templates=flat0,
@@ -682,7 +710,8 @@ class AsyncDistributedTrainer(Trainer):
                                   trace_context=ctx,
                                   failover=(self._ps_failover[0]
                                             if self._ps_failover else ()),
-                                  sparse_leaves=sparse_idx)
+                                  sparse_leaves=sparse_idx,
+                                  adaptive=self.adaptive)
             pipeline = self.pipeline
             # row-sparse exchange (ISSUE 9): each window's pull/commit
             # carries the sorted-unique row ids its batches touch — the

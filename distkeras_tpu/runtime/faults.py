@@ -137,17 +137,44 @@ class ChaosProxy:
     before forwarding — replication-lag injection: front a primary hub's
     address with it and point the replica's ``replica_of`` at the proxy,
     and the standby tracks the primary with a measured, constant lag
-    (planned per-frame faults still apply on top)."""
+    (planned per-frame faults still apply on top).
+
+    Slow-NIC emulation (ISSUE 10): ``bandwidth_bytes_per_s`` adds each
+    frame's serialization time at that bandwidth (big weight frames slow
+    proportionally, small acks barely), and ``jitter_delay_s=(lo, hi)``
+    adds a per-frame uniform draw from a ``seed``-derived RNG — each
+    (conn, direction) pump owns an independent stream keyed
+    ``(seed, conn, direction)``, so a throttled chaos run replays its
+    delay schedule bit-identically.  ``slow_conns`` restricts both to
+    the named accept ordinals (default: every connection) — fronting a
+    whole fleet with one proxy while throttling only conn 0 is how the
+    bench's adaptive leg makes exactly one straggler."""
 
     _CHUNK = 1 << 16
 
     def __init__(self, upstream_host: str, upstream_port: int,
                  plan: Optional[FaultPlan] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 delay_all_s: float = 0.0):
+                 delay_all_s: float = 0.0,
+                 bandwidth_bytes_per_s: Optional[float] = None,
+                 jitter_delay_s: Optional[Tuple[float, float]] = None,
+                 seed: Optional[int] = None,
+                 slow_conns: Optional[Sequence[int]] = None):
         self.upstream = (upstream_host, int(upstream_port))
         self.plan = plan or FaultPlan()
         self.delay_all_s = float(delay_all_s)
+        self.bandwidth_bytes_per_s = (None if not bandwidth_bytes_per_s
+                                      else float(bandwidth_bytes_per_s))
+        if jitter_delay_s is not None:
+            lo, hi = float(jitter_delay_s[0]), float(jitter_delay_s[1])
+            if not 0.0 <= lo <= hi:
+                raise ValueError(f"jitter_delay_s must be 0 <= lo <= hi, "
+                                 f"got ({lo}, {hi})")
+            jitter_delay_s = (lo, hi)
+        self.jitter_delay_s = jitter_delay_s
+        self.seed = seed
+        self.slow_conns = (None if slow_conns is None
+                           else frozenset(int(c) for c in slow_conns))
         self.host = host
         self.port = int(port)
         self._listener: Optional[socket.socket] = None
@@ -256,9 +283,32 @@ class ChaosProxy:
             dst.sendall(memoryview(buf)[:got])
             left -= got
 
+    def _frame_delay(self, rng, nbytes: int) -> float:
+        """Per-frame slow-NIC delay: serialization time at the configured
+        bandwidth plus one seeded jitter draw.  Deterministic per
+        (seed, conn, direction, frame ordinal) and the stream's frame
+        sizes — the reproducibility contract throttled chaos runs rely
+        on."""
+        d = 0.0
+        if self.bandwidth_bytes_per_s:
+            d += nbytes / self.bandwidth_bytes_per_s
+        if rng is not None:
+            lo, hi = self.jitter_delay_s
+            d += float(rng.uniform(lo, hi))
+        return d
+
     def _pump(self, conn_idx: int, direction: str,
               src: socket.socket, dst: socket.socket) -> None:
         frame_idx = 0
+        # slow-NIC emulation state: applies to this pump only when its
+        # conn ordinal is in slow_conns (or no restriction is set)
+        throttled = ((self.slow_conns is None or conn_idx in self.slow_conns)
+                     and (self.bandwidth_bytes_per_s is not None
+                          or self.jitter_delay_s is not None))
+        rng = (np.random.default_rng(
+            (0 if self.seed is None else int(self.seed), conn_idx,
+             0 if direction == "c2s" else 1))
+            if throttled and self.jitter_delay_s is not None else None)
         try:
             while True:
                 hdr = b""
@@ -288,6 +338,10 @@ class ChaosProxy:
                         time.sleep(fault.delay_s)
                 if self.delay_all_s > 0.0:
                     time.sleep(self.delay_all_s)
+                if throttled:
+                    d = self._frame_delay(rng, 8 + n)
+                    if d > 0.0:
+                        time.sleep(d)
                 dst.sendall(hdr)
                 self._relay(src, dst, n)
                 frame_idx += 1

@@ -104,7 +104,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            shard_index: Optional[int] = None,
                            replica_of: Optional[Any] = None,
                            health_jsonl: Optional[str] = None,
-                           sparse_tables: Optional[Any] = None) -> Any:
+                           sparse_tables: Optional[Any] = None,
+                           adaptive: bool = False) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -152,6 +153,13 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     every :class:`HealthEvent` to that path as JSON lines (durable even
     if the process dies before anyone polls).
 
+    Adaptive aggregation (ISSUE 10): ``adaptive=True`` makes the hub
+    merge queued commits Adasum-style, scale each worker's commits by
+    its live staleness standing (driven by the health plane's detector
+    events), and answer adaptive clients' reconnect hellos with
+    retry-after hints while a reconnect storm is live.  Python hub only;
+    pair with trainers started with the matching ``adaptive=True``.
+
     Row-sparse embedding service (ISSUE 9): ``sparse_tables="auto"``
     registers the model's declared EmbeddingTable leaves
     (``sparse_param_names`` on the architecture) so workers started with
@@ -183,6 +191,10 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
         raise ValueError("sparse_tables requires the Python hub (drop "
                          "native=True): the C++ hub has no sparse "
                          "pull/commit handlers")
+    if adaptive and native:
+        raise ValueError("adaptive requires the Python hub (drop "
+                         "native=True): the C++ hub has no adaptive "
+                         "combiner or backpressure handlers")
     if shard_index is not None and not (0 <= int(shard_index) < num_shards):
         raise ValueError(f"shard_index={shard_index} out of range for "
                          f"num_shards={num_shards}")
@@ -229,7 +241,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
         kwargs = ({"num_workers": num_workers, "elastic": elastic}
                   if mode == "adag" else {})
         return cls(hub_weights, host=host, port=hub_port,
-                   replica_of=replica_of, **kwargs, **common)
+                   replica_of=replica_of, adaptive=adaptive,
+                   **kwargs, **common)
 
     if health_jsonl is not None:
         # arm the process monitor's durable sink BEFORE serving: the first
@@ -322,6 +335,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "list of flat-leaf indices; workers started "
                              "with the matching sparse_tables knob then "
                              "exchange only the rows each batch touches")
+    parser.add_argument("--adaptive", action="store_true",
+                        help="telemetry-driven adaptive aggregation "
+                             "(Python hub only): merge queued commits "
+                             "Adasum-style, scale each worker's commits "
+                             "by its live staleness standing, and shed "
+                             "reconnect storms with retry-after hints "
+                             "(pair with trainers started adaptive=True)")
     parser.add_argument("--replica-of", default=None, metavar="HOST:PORT",
                         help="start as a hot standby of the primary hub at "
                              "this address: serve pulls immediately, stream "
@@ -333,6 +353,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         parser.error("--restore requires --snapshot-dir")
     if args.shard_index is not None and args.num_shards <= 1:
         parser.error("--shard-index requires --num-shards > 1")
+    if args.adaptive and args.native:
+        parser.error("--adaptive requires the Python hub (drop --native): "
+                     "the C++ hub has no adaptive combiner or backpressure "
+                     "handlers")
     if args.save_final and args.shard_index is not None:
         parser.error("--save-final needs the full center; a single-shard "
                      "process only holds its slice")
@@ -381,7 +405,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                                 shard_index=args.shard_index,
                                 replica_of=replica_of,
                                 health_jsonl=args.health_jsonl,
-                                sparse_tables=sparse_tables)
+                                sparse_tables=sparse_tables,
+                                adaptive=args.adaptive)
     if replica_of is not None:
         print(f"ps standby (replica of {replica_of[0]}:{replica_of[1]}) "
               f"listening on {args.host}:{ps.port}", flush=True)

@@ -170,10 +170,21 @@ class NativeParameterServer:
                  snapshot_keep: int = 3,
                  restore: bool = False,
                  shard_id: Optional[int] = None,
-                 replica_of: Optional[tuple] = None):
+                 replica_of: Optional[tuple] = None,
+                 adaptive: bool = False):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native PS unavailable: {build_error()}")
+        if adaptive:
+            # Documented Python-hub-only fallback (ISSUE 10): the adaptive
+            # combiner, rate controller and backpressure all live in the
+            # Python hub's commit/accept paths — the C++ hub applies
+            # commits in C++ with no hook for any of them.
+            raise NotImplementedError(
+                "adaptive aggregation requires the Python hub; the C++ hub "
+                "has no adaptive combiner or backpressure handlers — run "
+                "SocketParameterServer / distkeras-ps without --native "
+                "(identical wire protocol)")
         if replica_of is not None:
             # Documented Python-hub-only fallback (ISSUE 7): the C++ hub's
             # commit log (dk_ps_drain_commits) records clocks and timings

@@ -139,6 +139,22 @@ ACTION_SPARSE_WEIGHTS = b"V"
 ACTION_SPARSE_COMMIT = b"U"
 ACTION_SPARSE_QCOMMIT = b"X"
 
+# reconnect-storm backpressure (ISSUE 10): an ADAPTIVE client announces
+# every reconnect with a ``G`` frame (one 8-byte big-endian blob — the
+# hub-paced waits it has ALREADY taken this reconnect episode) as the
+# FIRST frame on the fresh connection; the hub replies with a ``Y`` frame
+# carrying a retry-after hint in milliseconds (one 8-byte big-endian
+# blob).  Hint 0 means proceed; a positive hint asks the client to close,
+# wait that long, and redial — the hub hands each member of a thundering
+# herd a LATER slot instead of absorbing the whole herd at once, and an
+# announcer that already waited its slot (blob > 0) is admitted, so every
+# client waits at most once per storm.  Opt-in like ``T``/``M``: no G
+# frame ever moves unless the client was constructed with
+# ``adaptive=True``, so every pre-existing frame stays byte-identical and
+# un-upgraded clients keep plain exponential backoff.
+ACTION_RECONNECT = b"G"
+ACTION_RETRY = b"Y"
+
 ROW_ID_DTYPE = np.dtype(np.int64)
 
 
@@ -437,6 +453,51 @@ def decode_time_payload(blobs: Sequence) -> int:
         raise ProtocolError(f"T timestamp blob has {len(raw)} bytes, want 8")
     (t_ns,) = struct.unpack(">Q", raw)
     return t_ns
+
+
+# -- reconnect backpressure (actions G / Y) -----------------------------------
+
+def encode_reconnect_payload(waits_taken: int) -> bytes:
+    """The adaptive client's reconnect announce (action ``G``): a tensor
+    frame whose single blob is the number of hub-paced waits this client
+    has already taken in the CURRENT reconnect episode, as an 8-byte
+    big-endian integer.  The hub hands slot hints only to announcers at
+    0 — a client that already waited is admitted, so a shed herd spreads
+    exactly once instead of looping on ever-later slots."""
+    return encode_tensors(
+        ACTION_RECONNECT,
+        [np.frombuffer(struct.pack(">Q", int(waits_taken)), np.uint8)])
+
+
+def encode_retry_payload(retry_after_ms: int) -> bytes:
+    """The hub's ``Y`` reply payload: one 8-byte big-endian blob carrying
+    the retry-after hint in milliseconds (0 = proceed now)."""
+    return encode_tensors(
+        ACTION_RETRY,
+        [np.frombuffer(struct.pack(">Q", int(retry_after_ms)), np.uint8)])
+
+
+def decode_retry_payload(blobs: Sequence) -> int:
+    """Inverse of :func:`encode_retry_payload` given the decoded blobs."""
+    if not blobs:
+        raise ProtocolError("Y reply carries no retry-after blob")
+    raw = bytes(memoryview(blobs[0]))[:8]
+    if len(raw) != 8:
+        raise ProtocolError(f"Y retry-after blob has {len(raw)} bytes, want 8")
+    (ms,) = struct.unpack(">Q", raw)
+    return ms
+
+
+def decode_reconnect_payload(blobs: Sequence) -> int:
+    """Inverse of :func:`encode_reconnect_payload` -> waits already taken
+    (tolerant: a malformed blob reads as 0 — backpressure must not take
+    down a reconnecting worker, it just gets a slot like a fresh one)."""
+    try:
+        raw = bytes(memoryview(blobs[0]))[:8]
+        (attempt,) = struct.unpack(">Q", raw)
+        return attempt
+    except (IndexError, struct.error, TypeError):
+        return 0
 
 
 # -- replication feed (action R) ----------------------------------------------

@@ -300,6 +300,427 @@ class ReplicationFeed:
             self._set_gauge()
 
 
+# -- adaptive aggregation (ISSUE 10) -------------------------------------------
+# The monitoring stack (PR 5/8) can name every async pathology — per-worker
+# staleness, stragglers, reconnect storms — but nothing ACTS on any of it.
+# The pieces below close that loop hub-side: queued commits merge
+# Adasum-style ("Scaling Distributed Training with Adaptive Summation",
+# arXiv:2006.02924) instead of applying sequentially, per-worker commit
+# scales follow the live staleness series (the DynSGD response of
+# arXiv:1611.04581, re-based on the fleet), and reconnect storms are shed
+# with retry-after hints instead of absorbed as a thundering herd.  All of
+# it rides ``adaptive=True``; the default-off path is byte-identical to the
+# pre-adaptive hub.
+#
+# A "commit" here is a per-leaf parts list aligned with the center: a full
+# ndarray for a dense leaf, an ``(ids, grads)`` pair (sorted-unique int64
+# ids, ``[k, dim]`` f32 grads) for a sparse leaf — the ONE representation
+# the merge rule, the combiner and the replication materialization all
+# share, so dense and sparse-row commits compose under the same math.
+
+
+def _adasum_dot(a_parts: Sequence[Any], b_parts: Sequence[Any]) -> float:
+    """Inner product of two commits in the center's flat vector space.
+    Sparse x sparse pairs contribute only their intersecting rows."""
+    total = 0.0
+    for a, b in zip(a_parts, b_parts):
+        if isinstance(a, tuple) and isinstance(b, tuple):
+            ids_a, ga = a
+            ids_b, gb = b
+            common, ia, ib = np.intersect1d(ids_a, ids_b,
+                                            assume_unique=True,
+                                            return_indices=True)
+            if common.size:
+                total += float(np.dot(ga[ia].ravel(), gb[ib].ravel()))
+        elif isinstance(a, tuple) or isinstance(b, tuple):
+            raise ValueError("adasum needs matching per-leaf representations"
+                             " (dense vs sparse); densify mixed batches "
+                             "first")
+        else:
+            total += float(np.dot(np.asarray(a).ravel(),
+                                  np.asarray(b).ravel()))
+    return total
+
+
+def _adasum_normsq(parts: Sequence[Any]) -> float:
+    total = 0.0
+    for p in parts:
+        flat = (p[1] if isinstance(p, tuple) else np.asarray(p)).ravel()
+        total += float(np.dot(flat, flat))
+    return total
+
+
+def _scale_parts(parts: Sequence[Any], scale: np.float32) -> List[Any]:
+    """One commit scaled by a float32 scalar (sparse rows scale in their
+    compact form — idle rows stay implicit zeros)."""
+    return [(p[0], p[1] * scale) if isinstance(p, tuple)
+            else np.asarray(p) * scale
+            for p in parts]
+
+
+def adasum_pair(a_parts: Sequence[Any], b_parts: Sequence[Any]) -> List[Any]:
+    """Adasum combine (arXiv:2006.02924) of two commits:
+
+        merged = (1 - <a,b> / 2|a|^2) * a  +  (1 - <a,b> / 2|b|^2) * b
+
+    — the plain sum when the two are orthogonal (independent progress
+    adds), the average when they are parallel (the same step must not
+    apply twice), and a smooth interpolation in between that never blows
+    the magnitude up.  A zero-norm side passes the other through
+    unchanged.  Symmetric in its arguments (the commutativity property
+    ``tests/test_adaptive.py`` pins); sparse leaves merge on the union of
+    their touched rows, so idle rows cost nothing."""
+    na = _adasum_normsq(a_parts)
+    nb = _adasum_normsq(b_parts)
+    if na == 0.0:
+        return list(b_parts)
+    if nb == 0.0:
+        return list(a_parts)
+    dot = _adasum_dot(a_parts, b_parts)
+    alpha = np.float32(1.0 - dot / (2.0 * na))
+    beta = np.float32(1.0 - dot / (2.0 * nb))
+    merged: List[Any] = []
+    for a, b in zip(a_parts, b_parts):
+        if isinstance(a, tuple):
+            ids_a, ga = a
+            ids_b, gb = b
+            ids = np.union1d(ids_a, ids_b)
+            out = np.zeros((ids.size, ga.shape[1]), np.float32)
+            if ids_a.size:
+                out[np.searchsorted(ids, ids_a)] += alpha * ga
+            if ids_b.size:
+                out[np.searchsorted(ids, ids_b)] += beta * gb
+            merged.append((ids, out))
+        else:
+            merged.append(alpha * np.asarray(a, np.float32)
+                          + beta * np.asarray(b, np.float32))
+    return merged
+
+
+def _mixed_repr(commits: Sequence[Sequence[Any]]) -> bool:
+    """True when any leaf is carried sparse ``(ids, grads)`` by one
+    commit and dense by another — a full-delta control commit
+    interleaving with sparse workers.  The combiner applies such a batch
+    SEQUENTIALLY: densifying the sparse sides to merge them would
+    materialize whole embedding tables under the center lock (the exact
+    cost the row-sparse service exists to avoid)."""
+    first = commits[0]
+    return any(
+        any(isinstance(c[i], tuple) != isinstance(first[i], tuple)
+            for c in commits[1:])
+        for i in range(len(first)))
+
+
+def adasum_merge(commits: Sequence[Sequence[Any]]) -> List[Any]:
+    """Balanced pairwise-tree Adasum reduction over a batch of commits —
+    the one merge rule the adaptive hub applies to every queued batch,
+    dense and sparse-row commits alike (per-leaf representations must
+    match across the batch; the combiner applies rare mixed batches
+    sequentially instead)."""
+    items = [list(c) for c in commits]
+    if not items:
+        raise ValueError("adasum_merge of an empty batch")
+    while len(items) > 1:
+        nxt = [adasum_pair(items[i], items[i + 1])
+               for i in range(0, len(items) - 1, 2)]
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]
+
+
+class AdaptiveRateController:
+    """DynSGD-style per-worker commit scaling driven by LIVE health events
+    (ISSUE 10; the degradation response of arXiv:1611.04581, re-based on
+    the fleet instead of clock zero).
+
+    The adaptive hub subscribes this controller to the process
+    :class:`~distkeras_tpu.observability.health.HealthMonitor`
+    (:meth:`~distkeras_tpu.observability.health.HealthMonitor.subscribe`
+    — push, not polling); each staleness/straggler event naming a worker
+    updates that worker's multiplicative commit scale — composed ON TOP
+    of the algorithm's own ``commit_scale(staleness)`` — from the
+    event's rolling-series evidence:
+
+    - ``staleness_drift`` (rolling mean vs fleet median):
+      ``(median + 1) / (mean + 1)``;
+    - ``staleness_spike`` (latest vs own rolling baseline):
+      ``(baseline + 1) / (staleness + 1)``;
+    - ``straggler`` (window wall vs fleet median): ``1 / factor``.
+
+    Scales clamp to ``[floor, 1.0]`` and EXPIRE after ``hold_s`` without
+    a refreshing event — detector cooldowns re-fire while a condition
+    persists, so a still-sick worker stays scaled and a recovered one
+    drifts back to 1.0.  Verdicts are kept PER EVENT KIND (the applied
+    scale is the min across a worker's unexpired kinds): a fresh event
+    of one kind REPLACES that kind's verdict — so a worker that improves
+    from severe to mild tracks the improving evidence — while a severe
+    verdict from another detector keeps its own clock and is never
+    silently extended by a weaker one.  ``scale_for`` is the commit
+    path's one dict read under a short lock."""
+
+    def __init__(self, floor: float = 0.1, hold_s: float = 30.0):
+        self.floor = float(floor)
+        self.hold_s = float(hold_s)
+        self._lock = threading.Lock()
+        # (worker, event kind) -> (scale, expires_monotonic)
+        self._scales: Dict[Tuple[str, str], Tuple[float, float]] = {}
+
+    def _propose(self, worker: str, kind: str, scale: float) -> None:
+        scale = min(1.0, max(self.floor, float(scale)))
+        with self._lock:
+            self._scales[(worker, kind)] = (scale,
+                                            time.monotonic() + self.hold_s)
+
+    def on_event(self, event: Any) -> None:
+        """:meth:`HealthMonitor.subscribe` callback.  Malformed evidence
+        is ignored — adaptation must never take down the path that
+        emitted the event."""
+        worker = getattr(event, "worker", None)
+        if worker is None:
+            return
+        ev = getattr(event, "evidence", None) or {}
+        try:
+            kind = event.kind
+            if kind == "staleness_drift":
+                self._propose(worker, kind,
+                              (float(ev.get("fleet_median", 0.0)) + 1.0)
+                              / (float(ev.get("staleness_mean", 0.0)) + 1.0))
+            elif kind == "staleness_spike":
+                self._propose(worker, kind,
+                              (float(ev.get("baseline", 0.0)) + 1.0)
+                              / (float(ev.get("staleness", 0.0)) + 1.0))
+            elif kind == "straggler":
+                self._propose(worker, kind,
+                              1.0 / max(1.0, float(ev.get("factor", 1.0))))
+        except (TypeError, ValueError):
+            return
+
+    def scale_for(self, worker: Any) -> float:
+        """The live multiplicative scale for one worker: the min across
+        its unexpired per-kind verdicts (1.0 when unknown, unattributed,
+        or fully expired)."""
+        if worker is None:
+            return 1.0
+        wkey = str(worker)
+        now = time.monotonic()
+        scale = 1.0
+        with self._lock:
+            for (w, kind), (s, expires) in list(self._scales.items()):
+                if now >= expires:
+                    del self._scales[(w, kind)]
+                elif w == wkey:
+                    scale = min(scale, s)
+        return scale
+
+    def snapshot(self) -> Dict[str, float]:
+        """Live (unexpired) per-worker scales (min across kinds),
+        JSON-safe."""
+        now = time.monotonic()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for (w, _), (s, exp) in self._scales.items():
+                if exp > now:
+                    out[w] = min(out.get(w, 1.0), s)
+        return out
+
+
+class _AdaptiveCombiner:
+    """Flat-combining commit application for an adaptive hub (ISSUE 10).
+
+    A plain hub serializes commits behind the center lock: while one
+    handler applies, the others block, and the fleet experiences the
+    queue as added staleness.  With ``adaptive=True`` every commit is
+    SUBMITTED here instead: each submitter enqueues its (parts, pull
+    clock, worker) and races for the drain lock; the winner grabs
+    everything queued at that instant as one BATCH, scales each member
+    by its own ``commit_scale(staleness)`` x the per-worker adaptive
+    rate, merges the batch pairwise Adasum-style (:func:`adasum_merge`)
+    and applies the merged delta as ONE center update.  Losers find
+    their entry already applied when they get the lock and return
+    immediately — commits that would have queued are combined, and an
+    uncontended hub degenerates to batches of one (whose apply is
+    bit-identical to the plain path at scale 1).
+
+    Clock semantics: a batch of K commits still advances the commit
+    clock and ``num_updates`` by K — staleness bookkeeping, elastic
+    denominators and the zero-acked-loss failover bound keep their
+    meaning; all members of a batch see the same base clock (they apply
+    simultaneously by construction).
+
+    Replication: the batch's merged delta is materialized center-shaped
+    and published as ONE ``R`` frame at the batch's final clock BEFORE
+    any member is acked, so a standby's center tracks the primary bit
+    for bit (its ``num_updates`` counts feed frames, not logical
+    commits — the CLOCK remains the failover bound, as before)."""
+
+    def __init__(self, hub: "SocketParameterServer",
+                 rate: Optional[AdaptiveRateController] = None):
+        self.hub = hub
+        self.rate = rate
+        self._qlock = threading.Lock()
+        self._drain = threading.Lock()
+        self._queue: List[Dict[str, Any]] = []
+        self.batches_total = 0
+        self.merged_total = 0  # commits folded into a larger batch
+        self.max_batch = 0
+
+    def commit(self, parts: Sequence[Any], last_pull_clock: int,
+               worker: Any = None) -> Dict[str, Any]:
+        """Submit one commit; returns its entry once APPLIED (and, when a
+        replica is attached, published), carrying the staleness and
+        scale it applied with.  The caller's buffers must stay valid
+        until return — handler threads block right here, so wire views
+        into their receive buffers are safe."""
+        entry: Dict[str, Any] = {"parts": list(parts),
+                                 "clock": int(last_pull_clock),
+                                 "worker": worker, "done": False,
+                                 "error": None,
+                                 "staleness": 0, "fenced": False,
+                                 "fence": 0, "scale": 1.0,
+                                 "rate_scale": 1.0, "batch": 1}
+        with self._qlock:
+            self._queue.append(entry)
+        with self._drain:
+            # the drain lock's release/acquire orders a predecessor's
+            # apply (and its done/error writes) before these reads.
+            # Invariant: an entry is either still in the queue (we will
+            # grab it below) or was grabbed by a predecessor, which
+            # marked it done or error before releasing — so the batch we
+            # grab always contains our own entry
+            if not entry["done"] and entry["error"] is None:
+                with self._qlock:
+                    batch, self._queue = self._queue, []
+                try:
+                    self._apply_batch(batch)
+                except BaseException as e:
+                    # a failed batch must not strand its members: mark
+                    # every un-applied entry so each submitter RAISES
+                    # (its connection drops / its worker sees the error
+                    # — never a false ack for a commit that was dropped)
+                    for en in batch:
+                        if not en["done"]:
+                            en["error"] = e
+                    raise
+        err = entry["error"]
+        if err is not None:
+            raise err
+        return entry
+
+    def _apply_batch(self, batch: List[Dict[str, Any]]) -> None:
+        hub = self.hub
+        telemetry = obs.enabled()
+        t0_ns = time.perf_counter_ns() if telemetry else 0
+        with hub._lock:
+            # the replicate decision is made UNDER the center lock, like
+            # _apply_commit_locked's: a replica attaching concurrently
+            # registers BEFORE snapshotting the center under this same
+            # lock, so either its sync includes this batch or active()
+            # is already True here and the batch is published — deciding
+            # earlier could lose the batch delta to a replica whose sync
+            # predates the apply
+            feed = hub._feed
+            replicate = feed is not None and feed.active()
+            clock0 = hub._clock
+            fence = hub._clock_fence
+            scaled_all: List[List[Any]] = []
+            for entry in batch:
+                lpc = entry["clock"]
+                if lpc < fence:
+                    lpc = fence
+                    entry["fenced"] = True
+                    entry["fence"] = fence
+                staleness = clock0 - lpc
+                wscale = (self.rate.scale_for(entry["worker"])
+                          if self.rate is not None else 1.0)
+                scale = float(hub.commit_scale(staleness)) * wscale
+                entry["staleness"] = staleness
+                entry["scale"] = scale
+                entry["rate_scale"] = wscale
+                entry["batch"] = len(batch)
+                scaled_all.append(
+                    _scale_parts(entry["parts"], np.float32(scale)))
+            if len(scaled_all) > 1 and not _mixed_repr(scaled_all):
+                applied = [adasum_merge(scaled_all)]
+            else:
+                # batch of one — or the RARE mixed dense/sparse batch,
+                # applied sequentially (plain queue-order semantics):
+                # merging it would densify sparse sides under this lock
+                applied = scaled_all
+            if replicate:
+                # replica contract: ONE center-shaped delta per batch
+                # (owned storage — _scale_parts' multiply owns), applied
+                # exactly as published, so primary and replica perform
+                # IDENTICAL float additions (bit-for-bit)
+                if len(applied) == 1 and not any(
+                        isinstance(p, tuple) for p in applied[0]):
+                    # the dominant case (uncontended all-dense commit):
+                    # the scaled copy already IS the center-shaped delta
+                    dense = applied[0]
+                else:
+                    dense = [np.zeros_like(c) for c in hub.center]
+                    for parts in applied:
+                        for full, p in zip(dense, parts):
+                            if isinstance(p, tuple):
+                                ids, g = p
+                                if ids.size:
+                                    full[ids] += g
+                            else:
+                                full += p
+                for c, full in zip(hub.center, dense):
+                    c += full
+            else:
+                dense = None
+                for parts in applied:
+                    for c, p in zip(hub.center, parts):
+                        if isinstance(p, tuple):
+                            ids, g = p
+                            if ids.size:
+                                c[ids] += g
+                        else:
+                            c += p
+            hub.num_updates += len(batch)
+            hub._clock += len(batch)
+            commit_clock = hub._clock
+        if replicate:
+            feed.publish(commit_clock, dense)
+        size = len(batch)
+        self.batches_total += 1
+        if size > self.max_batch:
+            self.max_batch = size
+        if size > 1:
+            self.merged_total += size - 1
+        if telemetry:
+            obs.gauge("ps_merge_queue_depth", **hub._mlabels).set(size)
+            obs.histogram("ps.merge_batch", **hub._mlabels).observe(size)
+            if size > 1:
+                obs.counter("ps_merged_commits_total",
+                            **hub._mlabels).inc(size - 1)
+            fenced = sum(1 for e in batch if e["fenced"])
+            if fenced:
+                obs.counter("ps_fenced_commits_total",
+                            **hub._mlabels).inc(fenced)
+            obs.TRACER.record_span("ps.merge", t0_ns,
+                                   time.perf_counter_ns(), batch=size,
+                                   **hub._shard_attrs)
+        # live health plane: applied scale joins each worker's series and
+        # the batch size joins the hub pseudo-worker's — distkeras-top's
+        # SCALE / MQ columns and fleet_report["adaptive"] read these
+        for entry in batch:
+            if entry["rate_scale"] < 1.0:
+                if telemetry:
+                    obs.counter("ps_rate_scaled_commits_total",
+                                **hub._mlabels).inc()
+            if entry["worker"] is not None:
+                hub._observe_health(entry["worker"], "adaptive_scale",
+                                    entry["rate_scale"])
+        hub._observe_health(
+            f"hub{'' if hub.shard_id is None else hub.shard_id}",
+            "merge_queue_depth", size, any_shard=True)
+        for entry in batch:
+            entry["done"] = True
+
+
 class SocketParameterServer:
     """Hub-and-spoke PS: one handler thread per worker connection, one lock
     around the center variable — the reference's concurrency model
@@ -319,6 +740,18 @@ class SocketParameterServer:
     socket exchange) so a mid-run ``obs.reset()`` cannot orphan them, and
     nothing is registered at all while telemetry is off."""
 
+    # reconnect-storm backpressure tuning (adaptive hubs, ISSUE 10):
+    # >= STORM_HELLOS reconnect hellos (action G) inside STORM_WINDOW_S
+    # arm shedding for STORM_SHED_S; each hello during shedding is handed
+    # the next RETRY_BASE_MS slot, capped at RETRY_CAP_MS — the herd is
+    # spread over time instead of absorbed at once.  Instance attributes,
+    # so tests and deployments can retune without subclassing
+    STORM_HELLOS = 3
+    STORM_WINDOW_S = 5.0
+    STORM_SHED_S = 3.0
+    RETRY_BASE_MS = 50
+    RETRY_CAP_MS = 2000
+
     def __init__(self, weights: Sequence[np.ndarray], host: str = "0.0.0.0", port: int = 0,
                  idle_timeout: Optional[float] = 300.0,
                  snapshot_dir: Optional[str] = None,
@@ -329,7 +762,8 @@ class SocketParameterServer:
                  replica_of: Optional[Tuple[str, int]] = None,
                  replica_feed_retries: int = 3,
                  replica_feed_backoff: float = 0.2,
-                 sparse_leaves: Sequence[int] = ()):
+                 sparse_leaves: Sequence[int] = (),
+                 adaptive: bool = False):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
@@ -431,6 +865,25 @@ class SocketParameterServer:
         self._health: Optional[Any] = None
         self._health_monitor: Optional[Any] = None
         self._health_mod: Optional[Any] = None  # cached module ref (peek path)
+        # telemetry-driven adaptive aggregation (ISSUE 10), OFF by
+        # default — the off path is byte-identical to the pre-adaptive
+        # hub (no combiner, no health subscription, no new wire frames).
+        # On: queued commits merge Adasum-style through the combiner,
+        # per-worker commit scales follow live health events, and
+        # reconnect hellos (action G) are answered with retry-after
+        # hints while a reconnect storm is live
+        self.adaptive = bool(adaptive)
+        self._rate: Optional[AdaptiveRateController] = None
+        self._combiner: Optional[_AdaptiveCombiner] = None
+        self._health_unsub: Optional[Any] = None
+        self._bp_lock = threading.Lock()
+        self._hello_times: Deque[float] = deque()
+        self._storm_until = 0.0
+        self._retry_seq = 0
+        self.backpressure_hints = 0  # nonzero hints issued (drills read it)
+        if self.adaptive:
+            self._rate = AdaptiveRateController()
+            self._combiner = _AdaptiveCombiner(self, self._rate)
         self.replica_of = (None if replica_of is None
                            else (str(replica_of[0]), int(replica_of[1])))
         self.replica_feed_retries = int(replica_feed_retries)
@@ -458,6 +911,20 @@ class SocketParameterServer:
 
     # -- lifecycle (reference: ParameterServer.start/stop) ---------------------
     def start(self) -> None:
+        if self.adaptive:
+            # bind the health plane eagerly and SUBSCRIBE (ISSUE 10): the
+            # per-commit staleness folds need a collector from commit
+            # one, and the rate controller / storm shedding must hear
+            # detector events the moment they fire — push, not polling
+            from distkeras_tpu.observability import health as _health
+
+            self._health_mod = _health
+            if self._health is None:
+                self._health = _health.collector()
+            if self._health_monitor is None:
+                self._health_monitor = _health.monitor()
+            self._health_unsub = self._health_monitor.subscribe(
+                self._on_health_event)
         if self._restore and self.snapshotter is not None:
             # load BEFORE binding: the first pull any worker lands must
             # already observe the restored center and fenced clock
@@ -504,6 +971,10 @@ class SocketParameterServer:
 
     def _shutdown(self, final_snapshot: bool) -> None:
         self._running = False
+        if self._health_unsub is not None and self._health_monitor is not None:
+            # a stopped hub must not keep reacting to a later run's events
+            self._health_monitor.unsubscribe(self._health_unsub)
+            self._health_unsub = None
         # stop tracking the primary BEFORE severing anything: a teardown
         # must never race the feed thread into a promotion
         self._replica_stop.set()
@@ -880,6 +1351,133 @@ class SocketParameterServer:
         self._health.observe(str(worker), metric, float(value),
                              shard=self.shard_id)
 
+    # -- adaptive reaction (ISSUE 10) ------------------------------------------
+    def _on_health_event(self, event: Any) -> None:
+        """:meth:`HealthMonitor.subscribe` callback (adaptive hubs only):
+        staleness/straggler events drive the per-worker rate controller,
+        and storm events arm reconnect backpressure — so a storm detected
+        from worker health REPORTS sheds load even before this hub has
+        seen a single reconnect hello itself."""
+        if getattr(event, "kind", None) in ("reconnect_storm",
+                                            "failover_storm"):
+            now = time.monotonic()
+            with self._bp_lock:
+                if now >= self._storm_until:
+                    self._retry_seq = 0
+                self._storm_until = max(self._storm_until,
+                                        now + self.STORM_SHED_S)
+        if self._rate is not None:
+            self._rate.on_event(event)
+
+    def _commit_adaptive(self, parts: Sequence[Any], last_pull_clock: int,
+                         worker: Any) -> Dict[str, Any]:
+        """Route one commit through the combiner (clock, fence, scaling
+        and replication ordering all live there) and give the detectors a
+        rate-limited chance to run off the commit path — an adaptive run
+        with no worker health reports still reacts to the hub's own
+        staleness folds."""
+        entry = self._combiner.commit(parts, last_pull_clock, worker=worker)
+        mon = self._health_monitor
+        if mon is not None:
+            mon.maybe_check()
+        return entry
+
+    def _commit_one(self, parts: Sequence[Any], last_pull_clock: int,
+                    worker: Any, sparse: bool,
+                    telemetry: bool) -> Tuple[int, int]:
+        """The ONE commit dispatch every commit path (dense/sparse x
+        socket/inproc) runs: adaptive routes through the combiner (clock,
+        fence, scaling, Adasum merge and replication ordering live
+        there); plain runs the pre-adaptive sequence verbatim — fence
+        clamp under the center lock, apply, advance clock, publish to
+        the replicas BEFORE returning (so the caller's ack keeps the
+        acked-commit-is-kernel-owned replication contract).  Returns
+        ``(staleness, last_pull_clock)``, the clock re-based when the
+        fence clamped it — a commit retried without a fresh pull must
+        not carry a dead incarnation's (or pre-promotion) clock as
+        staleness."""
+        if self._combiner is not None:
+            entry = self._commit_adaptive(parts, last_pull_clock, worker)
+            if entry["fenced"]:
+                last_pull_clock = entry["fence"]
+            return entry["staleness"], last_pull_clock
+        with self._lock:
+            if last_pull_clock < self._clock_fence:
+                last_pull_clock = self._clock_fence
+                if telemetry:
+                    obs.counter("ps_fenced_commits_total",
+                                **self._mlabels).inc()
+            staleness = self._clock - last_pull_clock
+            scaled = (self._apply_sparse_commit_locked(parts, staleness)
+                      if sparse else
+                      self._apply_commit_locked(parts, staleness))
+            self.num_updates += 1
+            self._clock += 1
+            commit_clock = self._clock
+        if scaled is not None:
+            self._feed.publish(commit_clock, scaled)
+        return staleness, last_pull_clock
+
+    def _retry_after_ms(self, waits_taken: int = 0) -> int:
+        """Answer one reconnect hello (action ``G``): 0 = proceed now,
+        else the caller's retry-after slot in milliseconds.  Every hub
+        answers ``G`` (an adaptive client may dial any hub of this
+        generation), but only an adaptive hub in a live storm hints
+        nonzero — and only to announcers that have NOT already waited a
+        slot this episode (``waits_taken == 0``), so the herd spreads
+        exactly once and every member is admitted on its paced return.
+        Storms arm two ways: the health monitor's storm detectors (via
+        the subscription), and self-detection from the hello arrival
+        rate here — a herd reconnecting after a network blip is shed
+        even when no worker reports health."""
+        if not self.adaptive:
+            return 0
+        now = time.monotonic()
+        storm_started = False
+        with self._bp_lock:
+            if waits_taken <= 0:
+                # only FRESH reconnects are storm evidence: a shed herd's
+                # paced returns (waits_taken > 0) are the drain, not the
+                # storm — counting them would re-arm shedding against
+                # the next innocent lone reconnect
+                self._hello_times.append(now)
+            while self._hello_times and \
+                    now - self._hello_times[0] > self.STORM_WINDOW_S:
+                self._hello_times.popleft()
+            if now >= self._storm_until \
+                    and len(self._hello_times) >= self.STORM_HELLOS:
+                self._storm_until = now + self.STORM_SHED_S
+                self._retry_seq = 0
+                storm_started = True
+            if now < self._storm_until and waits_taken <= 0:
+                self._retry_seq += 1
+                hint = min(self.RETRY_CAP_MS,
+                           self.RETRY_BASE_MS * self._retry_seq)
+                # counted under the lock: concurrent handler threads
+                # during a storm must not lose increments
+                self.backpressure_hints += 1
+            else:
+                hint = 0
+        if storm_started:
+            # observable like any monitor-detected storm; the emit also
+            # re-arms shedding through the subscription (idempotent)
+            try:
+                mon = self._health_monitor
+                if mon is not None:
+                    mon.emit("reconnect_storm", "critical",
+                             shard=self.shard_id,
+                             dedup=f"hub-hellos:{self.host}:{self.port}",
+                             hellos=len(self._hello_times),
+                             window_s=self.STORM_WINDOW_S)
+            except Exception:
+                pass
+        if hint and obs.enabled():
+            obs.counter("ps_backpressure_hints_total",
+                        **self._mlabels).inc()
+            obs.histogram("ps.retry_after_ms",
+                          **self._mlabels).observe(hint)
+        return hint
+
     # -- serving loop (reference: SocketParameterServer.run) -------------------
     def _accept_loop(self) -> None:
         assert self._listener is not None
@@ -1168,29 +1766,13 @@ class SocketParameterServer:
                         self._member_join(member_token)
                     with obs.span("ps.handle_commit", conn=conn_idx,
                                   **self._shard_attrs, **ctx_attrs) as sp:
-                        with self._lock:
-                            if last_pull_clock < self._clock_fence:
-                                # the fence moved UNDER this live connection
-                                # (a standby promoted after the connection
-                                # was born): re-base, exactly like the
-                                # inproc path — otherwise a commit retried
-                                # without a fresh pull would carry the full
-                                # replicated clock as staleness and DynSGD
-                                # would near-zero it
-                                last_pull_clock = self._clock_fence
-                                if telemetry:
-                                    obs.counter("ps_fenced_commits_total",
-                                                **self._mlabels).inc()
-                            staleness = self._clock - last_pull_clock
-                            scaled = self._apply_commit_locked(delta, staleness)
-                            self.num_updates += 1
-                            self._clock += 1
-                            commit_clock = self._clock
-                        if scaled is not None:
-                            # stream to the replica(s) BEFORE acking: once
-                            # the worker sees this ack, the commit is at
-                            # least kernel-owned on its way to the standby
-                            self._feed.publish(commit_clock, scaled)
+                        # one shared dispatch (adaptive combiner or the
+                        # pre-adaptive fence/apply/publish sequence);
+                        # either way the commit is applied AND replicated
+                        # before the ack below leaves
+                        staleness, last_pull_clock = self._commit_one(
+                            delta, last_pull_clock, ctx_attrs.get("worker"),
+                            sparse=False, telemetry=telemetry)
                         net.send_raw_frame(conn, ack)
                         if getattr(sp, "attrs", None) is not None:
                             # the span's attribution payload: the staleness
@@ -1288,20 +1870,9 @@ class SocketParameterServer:
                     with obs.span("ps.handle_commit", conn=conn_idx,
                                   sparse_rows=rows_committed,
                                   **self._shard_attrs, **ctx_attrs) as sp:
-                        with self._lock:
-                            if last_pull_clock < self._clock_fence:
-                                last_pull_clock = self._clock_fence
-                                if telemetry:
-                                    obs.counter("ps_fenced_commits_total",
-                                                **self._mlabels).inc()
-                            staleness = self._clock - last_pull_clock
-                            scaled = self._apply_sparse_commit_locked(
-                                parts, staleness)
-                            self.num_updates += 1
-                            self._clock += 1
-                            commit_clock = self._clock
-                        if scaled is not None:
-                            self._feed.publish(commit_clock, scaled)
+                        staleness, last_pull_clock = self._commit_one(
+                            parts, last_pull_clock, ctx_attrs.get("worker"),
+                            sparse=True, telemetry=telemetry)
                         net.send_raw_frame(conn, ack)
                         if getattr(sp, "attrs", None) is not None:
                             sp.attrs["staleness"] = staleness
@@ -1378,6 +1949,16 @@ class SocketParameterServer:
                     except Exception:
                         pass
                     net.send_raw_frame(conn, ack)
+                elif action == net.ACTION_RECONNECT:
+                    # adaptive reconnect announce (ISSUE 10): answer with
+                    # a retry-after hint (0 = proceed; announcers that
+                    # already waited their slot are admitted).  Every hub
+                    # of this generation answers G — the frame only ever
+                    # moves when the CLIENT opted in with adaptive=True,
+                    # so pre-existing byte streams are untouched
+                    net.send_frame(conn, net.encode_retry_payload(
+                        self._retry_after_ms(
+                            net.decode_reconnect_payload(blobs))))
                 elif action == net.ACTION_PING:
                     # heartbeat-on-idle: proves liveness (resetting the
                     # idle clock above) and keeps a slow-but-alive worker's
@@ -1466,24 +2047,13 @@ class SocketParameterServer:
                   for d, c in zip(delta, self.center)]
         with obs.span("ps.handle_commit", transport="inproc",
                       **self._shard_attrs, **dtrace.current_span_attrs()) as sp:
-            with self._lock:
-                if last_pull_clock < self._clock_fence:
-                    # pre-restart pull clock: fence it at the restore point —
-                    # the commit applies with restart-relative staleness
-                    # instead of a clock from a dead incarnation
-                    last_pull_clock = self._clock_fence
-                    if telemetry:
-                        obs.counter("ps_fenced_commits_total",
-                                    **self._mlabels).inc()
-                staleness = self._clock - last_pull_clock
-                scaled = self._apply_commit_locked(arrays, staleness)
-                self.num_updates += 1
-                self._clock += 1
-                commit_clock = self._clock
-            if scaled is not None:
-                # the inproc "ack" is this call returning: stream first,
-                # same ordering contract as the socket handler
-                self._feed.publish(commit_clock, scaled)
+            # the inproc call runs IN the worker's thread, so its
+            # thread-local trace context names the worker; the re-based
+            # clock is discarded — inproc callers present theirs per call
+            staleness, _ = self._commit_one(
+                arrays, last_pull_clock,
+                dtrace.current_span_attrs().get("worker"),
+                sparse=False, telemetry=telemetry)
             if getattr(sp, "attrs", None) is not None:
                 sp.attrs["staleness"] = staleness
         if self._health is not None:
@@ -1577,19 +2147,10 @@ class SocketParameterServer:
         with obs.span("ps.handle_commit", transport="inproc",
                       sparse_rows=rows_committed, **self._shard_attrs,
                       **dtrace.current_span_attrs()) as sp:
-            with self._lock:
-                if last_pull_clock < self._clock_fence:
-                    last_pull_clock = self._clock_fence
-                    if telemetry:
-                        obs.counter("ps_fenced_commits_total",
-                                    **self._mlabels).inc()
-                staleness = self._clock - last_pull_clock
-                scaled = self._apply_sparse_commit_locked(norm, staleness)
-                self.num_updates += 1
-                self._clock += 1
-                commit_clock = self._clock
-            if scaled is not None:
-                self._feed.publish(commit_clock, scaled)
+            staleness, _ = self._commit_one(
+                norm, last_pull_clock,
+                dtrace.current_span_attrs().get("worker"),
+                sparse=True, telemetry=telemetry)
             if getattr(sp, "attrs", None) is not None:
                 sp.attrs["staleness"] = staleness
         if self._health is not None:
@@ -1914,7 +2475,8 @@ class PSClient:
                  trace_context: Optional["dtrace.TraceContext"] = None,
                  shard_id: Optional[int] = None,
                  failover: Sequence[Tuple[str, int]] = (),
-                 sparse_leaves: Sequence[int] = ()):
+                 sparse_leaves: Sequence[int] = (),
+                 adaptive: bool = False):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -1994,6 +2556,13 @@ class PSClient:
         # cumulative count the worker's health reports carry (ISSUE 8), so
         # the hub-side failover-storm detector sees it as a moving series
         self.failovers_used = 0
+        # reconnect-storm backpressure (ISSUE 10): adaptive clients
+        # announce every reconnect with an action-G frame and honor the
+        # hub's retry-after hint — a shed herd spreads over time instead
+        # of hammering the hub in lockstep.  Default off: no G frame ever
+        # moves, the byte stream is exactly the pre-adaptive one
+        self.adaptive = bool(adaptive)
+        self.backpressure_waits = 0
         # entropy-seeded ON PURPOSE: the jitter exists so a fleet of
         # workers severed by one hub restart does NOT retry in lockstep —
         # a shared deterministic seed would reproduce exactly that herd
@@ -2074,6 +2643,30 @@ class PSClient:
 
     # -- resilience ------------------------------------------------------------
     _RETRYABLE = (ConnectionError, OSError, net.ProtocolError)
+    # hub-paced retry-after waits are refunded from the reconnect budget
+    # up to this many times; past it they start consuming budget again,
+    # so a hub that never stops hinting cannot livelock a worker forever
+    _MAX_BP_WAITS = 32
+    # ceiling on any single honored hint: the hub caps its own at 2 s,
+    # so a larger value is a version-skewed/buggy hub or a corrupted
+    # blob — a worker must never be parked on a garbage uint64 of ms
+    _MAX_RETRY_AFTER_MS = 10_000
+
+    def _reconnect_hello(self, waits_taken: int) -> int:
+        """The G/Y round trip on a freshly dialed connection (adaptive
+        clients only): announce the reconnect — carrying how many
+        hub-paced waits this episode already took, so a client that
+        waited its slot is admitted — and return the hub's retry-after
+        hint in milliseconds.  Connection faults raise the usual
+        retryable types — the attempt's handler rotates and backs off
+        exactly as for a failed dial."""
+        net.send_frame(self.sock,
+                       net.encode_reconnect_payload(waits_taken))
+        action, blobs = net.recv_tensors(self.sock)
+        if action != net.ACTION_RETRY:
+            raise net.ProtocolError(
+                f"expected Y reply to reconnect announce, got {action!r}")
+        return min(net.decode_retry_payload(blobs), self._MAX_RETRY_AFTER_MS)
 
     def _connect_any(self) -> socket.socket:
         """Initial connect: the primary first, then each failover address
@@ -2192,6 +2785,14 @@ class PSClient:
                 self.sock.close()
             except OSError:
                 pass
+            # hub-paced waits taken in THIS reconnect episode: the G
+            # announce carries it, so the hub admits us once we have
+            # waited our slot (one wait per client per storm).  A redial
+            # right after a slot wait skips the exponential backoff —
+            # the hub just SCHEDULED our arrival; re-randomizing on top
+            # would scramble the paced order the slots exist to create
+            bp_episode = 0
+            skip_backoff = False
             while True:
                 if self.reconnects_used >= self.max_reconnects:
                     raise ConnectionError(
@@ -2201,10 +2802,13 @@ class PSClient:
                            if len(self._addresses) > 1 else "")
                     ) from cause
                 self.reconnects_used += 1
-                nominal = min(self.reconnect_backoff
-                              * (2.0 ** (self.reconnects_used - 1)),
-                              self.reconnect_backoff_max)
-                time.sleep(nominal * (0.5 + 0.5 * self._jitter.random()))
+                if skip_backoff:
+                    skip_backoff = False
+                else:
+                    nominal = min(self.reconnect_backoff
+                                  * (2.0 ** (self.reconnects_used - 1)),
+                                  self.reconnect_backoff_max)
+                    time.sleep(nominal * (0.5 + 0.5 * self._jitter.random()))
                 # address rotation: the current address gets one retry,
                 # then attempts walk the failover list — a dead primary's
                 # refused connect fails fast, so the standby is reached
@@ -2215,6 +2819,33 @@ class PSClient:
                                             timeout=self.timeout,
                                             payload_hint=self._codec.frame_len)
                     self.host, self.port = host, port
+                    # reconnect-storm backpressure (ISSUE 10): announce
+                    # the reconnect (action G) and honor the hub's
+                    # retry-after hint.  Hub-paced waits are
+                    # budget-NEUTRAL (refunded, bounded by _MAX_BP_WAITS
+                    # against a hub that never stops hinting): being told
+                    # to wait by a healthy hub is not a fault, and a shed
+                    # herd must not exhaust its reconnect budgets
+                    if self.adaptive:
+                        hint_ms = self._reconnect_hello(bp_episode)
+                        if hint_ms > 0:
+                            try:
+                                self.sock.close()
+                            except OSError:
+                                pass
+                            bp_episode += 1
+                            self.backpressure_waits += 1
+                            if bp_episode <= self._MAX_BP_WAITS:
+                                self.reconnects_used -= 1
+                            if obs.enabled():
+                                obs.counter("ps.backpressure_waits",
+                                            **self._mlabels).inc()
+                                obs.histogram("ps.retry_after_wait_ms",
+                                              **self._mlabels).observe(
+                                    hint_ms)
+                            time.sleep(hint_ms / 1000.0)
+                            skip_backoff = True
+                            continue
                     # re-announce the trace context on the fresh
                     # connection (a restarted hub has no memory of the
                     # old one) and refresh the clock-offset estimate
@@ -3414,7 +4045,8 @@ class ShardedPSClient:
                  heartbeat_interval: Optional[float] = None,
                  trace_context: Optional["dtrace.TraceContext"] = None,
                  failover: Optional[Sequence[Any]] = None,
-                 sparse_leaves: Sequence[int] = ()):
+                 sparse_leaves: Sequence[int] = (),
+                 adaptive: bool = False):
         if len(addresses) != plan.num_shards:
             raise ValueError(f"got {len(addresses)} shard addresses, plan "
                              f"has {plan.num_shards} shards")
@@ -3459,7 +4091,8 @@ class ShardedPSClient:
                     sparse_leaves=plan.local_sparse(sid)
                     if self._sparse else (),
                     failover=_normalize_failover(
-                        failover[sid] if failover is not None else None))
+                        failover[sid] if failover is not None else None),
+                    adaptive=adaptive)
                 # rebind the shard client's caches to row-range views of
                 # the full tables (contiguous slices, so fancy-indexed
                 # merges land in the full cache directly)

@@ -364,6 +364,76 @@ def test_failover_acceptance_block_tripwires():
     assert out["acceptance"]["snapshot_barrier_ok"] is None
 
 
+def test_adaptive_acceptance_block_tripwires():
+    """The issue-10 adaptive tripwires: adaptive beats plain final loss
+    at comparable wall (ratio <= 1.25), and the control loop visibly
+    reacted (merged or rate-scaled >= 1 commit) — None-degrading when
+    either leg errored or the whole sub-leg is missing."""
+    out = {
+        "fault_free": {"wall_s": 10.0, "final_loss": 2.0},
+        "sever": {"error": "skipped"},
+        "worker_restart": {"error": "skipped"},
+        "adaptive": {
+            "plain": {"wall_s": 10.0, "final_loss": 2.30,
+                      "merged_commits": 0.0, "rate_scaled_commits": 0.0},
+            "adaptive": {"wall_s": 11.0, "final_loss": 2.10,
+                         "merged_commits": 5.0,
+                         "rate_scaled_commits": 3.0},
+        },
+    }
+    bench._async_recovery_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["adaptive_plain_final_loss"] == 2.30
+    assert acc["adaptive_final_loss"] == 2.10
+    assert acc["adaptive_wall_ratio"] == 1.1
+    assert acc["adaptive_beats_plain_ok"] is True
+    assert acc["adaptive_reacted_ok"] is True
+
+    # adaptive landing WORSE than plain flips the tripwire
+    out["adaptive"]["adaptive"]["final_loss"] = 2.50
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["adaptive_beats_plain_ok"] is False
+    # equal-work walls drifting apart invalidates the comparison too
+    out["adaptive"]["adaptive"]["final_loss"] = 2.10
+    out["adaptive"]["adaptive"]["wall_s"] = 20.0
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["adaptive_beats_plain_ok"] is False
+    # a control loop that never reacted is its own failure
+    out["adaptive"]["adaptive"]["wall_s"] = 11.0
+    out["adaptive"]["adaptive"]["merged_commits"] = 0.0
+    out["adaptive"]["adaptive"]["rate_scaled_commits"] = 0.0
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["adaptive_reacted_ok"] is False
+
+    # an errored plain leg degrades the comparison (not the reaction
+    # check); a missing sub-leg degrades everything — never a crash
+    out["adaptive"]["adaptive"]["merged_commits"] = 5.0
+    out["adaptive"]["plain"] = {"error": "ConnectionError: proxy died"}
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["adaptive_beats_plain_ok"] is None
+    assert out["acceptance"]["adaptive_reacted_ok"] is True
+    del out["adaptive"]
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["adaptive_beats_plain_ok"] is None
+    assert out["acceptance"]["adaptive_reacted_ok"] is None
+    assert out["acceptance"]["adaptive_wall_ratio"] is None
+
+
+@pytest.mark.slow
+def test_bench_async_adaptive_tiny_e2e():
+    """The adaptive bench leg end to end at a CI-scale shape: both legs
+    run, record losses/walls, and the adaptive leg's counters exist."""
+    out = bench._bench_async_adaptive(workers=2, window=2, batch=16,
+                                      windows_per_epoch=2, epochs=1,
+                                      jitter_s=(0.001, 0.002))
+    for name in ("plain", "adaptive"):
+        leg = out[name]
+        assert "error" not in leg, leg
+        assert leg["final_loss"] is not None
+        assert leg["wall_s"] > 0
+    assert out["adaptive"]["merged_commits"] >= 0.0
+
+
 def test_observability_acceptance_block_tripwires():
     """The issue-5 tripwire block: tracing overhead under the 3% target,
     >=95% commit-context coverage, straggler ranking present — with None

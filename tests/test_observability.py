@@ -832,42 +832,57 @@ def _ast_unused_imports(path):
 
 
 @pytest.mark.parametrize("package", ["observability", "runtime", ".", "tests",
-                                     "data", "parallel", "models", "ops"])
+                                     "data", "parallel", "models", "ops",
+                                     "examples", "bench"])
 def test_package_is_lint_clean(package):
     """Satellite (PR 5, extended to runtime/ by PR 6, to the package's
     top-level modules — checkpoint.py, utils.py, trainers.py, ... — by
-    PR 7, to ``tests/`` itself by PR 8, and to the remaining packages —
-    data/, parallel/, models/, ops/ — by PR 9): ruff-clean check scoped
-    to the instrumented packages.  Runs real ruff when the container has
-    it; otherwise falls back to an AST unused-import (F401) sweep plus a
+    PR 7, to ``tests/`` itself by PR 8, to the remaining packages —
+    data/, parallel/, models/, ops/ — by PR 9, and to the last
+    uncovered trees — both ``examples`` directories and the root-level
+    ``bench.py`` — by PR 10): ruff-clean check scoped to the
+    instrumented packages.  Runs real ruff when the container has it;
+    otherwise falls back to an AST unused-import (F401) sweep plus a
     compile check.  ``"."`` scans the ``distkeras_tpu/*.py`` files
     themselves (non-recursive; the subpackages have their own
-    parametrized cells); ``"tests"`` scans this directory."""
+    parametrized cells); ``"tests"`` scans this directory;
+    ``"examples"`` scans ``distkeras_tpu/examples/`` AND the repo-root
+    ``examples/``; ``"bench"`` is the root ``bench.py`` file."""
     import os
     import py_compile
     import shutil
     import subprocess
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pkg = (os.path.join(root, "tests") if package == "tests"
-           else os.path.join(root, "distkeras_tpu", package))
-    pkg = os.path.normpath(pkg)
+    if package == "tests":
+        files = [os.path.join(root, "tests", f)
+                 for f in sorted(os.listdir(os.path.join(root, "tests")))
+                 if f.endswith(".py")]
+    elif package == "bench":
+        files = [os.path.join(root, "bench.py")]
+    elif package == "examples":
+        files = []
+        for d in (os.path.join(root, "distkeras_tpu", "examples"),
+                  os.path.join(root, "examples")):
+            if os.path.isdir(d):
+                files.extend(os.path.join(d, f)
+                             for f in sorted(os.listdir(d))
+                             if f.endswith(".py"))
+    else:
+        pkg = os.path.normpath(os.path.join(root, "distkeras_tpu", package))
+        files = [os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
+                 if f.endswith(".py")]
     ruff = shutil.which("ruff")
     if ruff:
-        target = pkg if package != "." else [
-            os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
-            if f.endswith(".py")]
-        cmd = [ruff, "check"] + (target if isinstance(target, list) else [target])
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        proc = subprocess.run([ruff, "check"] + files, capture_output=True,
+                              text=True, timeout=120)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         return
-    for fname in sorted(os.listdir(pkg)):
-        if not fname.endswith(".py"):
-            continue
-        path = os.path.join(pkg, fname)
+    for path in files:
         py_compile.compile(path, doraise=True)
         unused = _ast_unused_imports(path)
-        assert not unused, f"{fname}: unused imports {unused}"
+        assert not unused, \
+            f"{os.path.basename(path)}: unused imports {unused}"
 
 
 def test_telemetry_disabled_leaves_async_run_unrecorded(toy_dataset):
