@@ -1457,6 +1457,14 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
     except Exception as ex:
         out["shard_scaling"] = {"error": f"{type(ex).__name__}: {ex}"}
 
+    # native feature-parity legs (ISSUE 11): each newly ported feature
+    # combination on BOTH hubs, with a per-leg native-beats-python
+    # tripwire.  Individually fallible like every other leg
+    try:
+        out["native_features"] = _bench_async_native_features()
+    except Exception as ex:
+        out["native_features"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     _async_acceptance(out)
     return out
 
@@ -1698,6 +1706,90 @@ def _async_acceptance(out: dict) -> None:
                                   else bool(speedup >= 5.0)),
         "final_loss_parity": parity,
     }
+
+
+def _bench_async_native_features(*, workers: int = 2, window: int = 4,
+                                 batch: int = 64, windows_per_epoch: int = 4,
+                                 epochs: int = 2, rows: int = 256,
+                                 dim: int = 8, fields: int = 4):
+    """ISSUE-11 acceptance legs: every newly ported native feature
+    combination — ``sparse`` (S/V/U/X row exchange), ``adaptive`` (the
+    C++ Adasum flat-combining merger) and ``sparse_adaptive`` — runs the
+    SAME CTR training on the Python hub and the C++ hub, and the
+    tripwire pins the native leg at-or-under the Python hub's per-window
+    wall (``native_beats_python_ok``, None-degrading per the PR-3
+    convention).  The pre-existing ``async_adag_native`` leg covers the
+    dense plain combination; these cover what ISSUE 11 ported."""
+    import numpy as np
+
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.embedding import ctr_embedding_spec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+
+    spec = ctr_embedding_spec(rows, dim=dim, fields=fields,
+                              hidden_sizes=(16,))
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    ds = Dataset({
+        "features": rng.integers(0, rows, size=(n, fields)).astype(np.int32),
+        "label": np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=n)],
+    })
+    out = {"workers": workers, "window": window, "batch": batch,
+           "epochs": epochs, "timing": "wall"}
+    combos = {"sparse": {"sparse_tables": "auto"},
+              "adaptive": {"adaptive": True},
+              "sparse_adaptive": {"sparse_tables": "auto",
+                                  "adaptive": True}}
+    for leg, extra in combos.items():
+        for hub in ("python", "native"):
+            name = f"{leg}_{hub}"
+            try:
+                tr = AsyncADAG(Model.init(spec, seed=0), num_workers=workers,
+                               communication_window=window,
+                               loss="categorical_crossentropy",
+                               batch_size=batch, num_epoch=epochs,
+                               learning_rate=0.01, seed=0,
+                               native_ps=(hub == "native"), **extra)
+                tr.train(ds, shuffle=False)  # compile + warm
+                tr.model = Model.init(spec, seed=0)
+                tr.history = []
+                t0 = time.perf_counter()
+                tr.train(ds, shuffle=False)
+                wall = time.perf_counter() - t0
+                n_windows = max(len(tr.history), 1)
+                out[name] = {
+                    "hub": hub,
+                    "wall_s": round(wall, 3),
+                    "per_window_wall_ms": round(wall * 1e3 / n_windows, 2),
+                    "samples_per_sec": round(n * epochs / wall, 1),
+                }
+            except Exception as ex:
+                out[name] = {"error": f"{type(ex).__name__}: {ex}"}
+    _native_features_acceptance(out)
+    return out
+
+
+def _native_features_acceptance(out: dict) -> None:
+    """Attach the ISSUE-11 tripwires, in place: for each ported feature
+    combination, the native leg must beat (<=) its Python-hub equivalent
+    on per-window wall.  None (not a crash) wherever a leg is missing or
+    errored — the PR-3 convention."""
+    def _ok(name):
+        return isinstance(out.get(name), dict) and "error" not in out[name]
+
+    acc = {}
+    for leg in ("sparse", "adaptive", "sparse_adaptive"):
+        ratio = None
+        if _ok(f"{leg}_python") and _ok(f"{leg}_native"):
+            py = out[f"{leg}_python"].get("per_window_wall_ms") or 0
+            nat = out[f"{leg}_native"].get("per_window_wall_ms")
+            if py and nat is not None:
+                ratio = round(nat / py, 4)
+        acc[f"{leg}_native_vs_python"] = ratio
+        acc[f"{leg}_native_beats_python_ok"] = (None if ratio is None
+                                               else bool(ratio <= 1.0))
+    out["acceptance"] = acc
 
 
 def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256,

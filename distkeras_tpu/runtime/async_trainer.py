@@ -242,10 +242,8 @@ class AsyncDistributedTrainer(Trainer):
                 raise ValueError("replica_of requires num_shards=1 (a "
                                  "sharded deployment runs one standby "
                                  "daemon per shard primary)")
-            if native_ps:
-                raise ValueError("replica_of requires the Python hub "
-                                 "(native_ps=False); see "
-                                 "NativeParameterServer")
+            # both hubs serve replica_of (the C++ standby runs its feed
+            # thread native-side; ISSUE 11) — no native guard needed
         self.checkpoint_interval = float(checkpoint_interval)
         # failure policy (SURVEY §5 "failure detection" — the reference had
         # none; Spark silently re-ran dead executors).  "raise" surfaces the
@@ -303,20 +301,16 @@ class AsyncDistributedTrainer(Trainer):
         # wire action M on the pipelined FIFO (socket) or a direct
         # collector fold (inproc) — where the online detectors run over
         # the per-worker sliding windows.  Default None = OFF: no M frame
-        # ever leaves, so pre-M hubs interoperate byte-identically.  The
-        # C++ hub has no M handler: over sockets a report against it is a
-        # connection fault, hence the guard below
+        # ever leaves, so pre-M hubs interoperate byte-identically.
+        # Both hubs ingest M (the C++ hub parks reports in a ring its
+        # wrapper drains into the collector; ISSUE 11)
         if health_interval_s is not None:
             health_interval_s = float(health_interval_s)
             if health_interval_s <= 0:
                 raise ValueError(f"health_interval_s must be positive, "
                                  f"got {health_interval_s}")
-            if native_ps and transport == "socket":
-                raise ValueError(
-                    "health_interval_s requires a Python hub over sockets "
-                    "(the C++ hub has no health-report handler); use "
-                    "transport='inproc' (reports fold into the process "
-                    "collector directly) or drop native_ps")
+            # both hubs ingest action-M reports (the C++ hub parks them
+            # in a ring its Python wrapper drains into the collector)
         self.health_interval_s = health_interval_s
         # row-sparse embedding tables (ISSUE 9): None (default) = fully
         # off, every wire byte identical to the dense stack.  "auto"
@@ -332,12 +326,16 @@ class AsyncDistributedTrainer(Trainer):
             sparse_tables = tuple(sorted({int(i) for i in sparse_tables}))
         self.sparse_tables = sparse_tables
         if sparse_tables is not None:
-            if native_ps:
+            if native_ps and transport == "inproc":
+                # the ONE remaining Python-hub-only combination (ISSUE 11):
+                # the C++ hub serves the full sparse WIRE plane (S/V/U/X)
+                # but has no pull_sparse_direct/commit_sparse_direct pair
                 raise ValueError(
-                    "sparse_tables requires the Python hub (native_ps="
-                    "False): the C++ hub has no sparse pull/commit "
-                    "handlers — drop native_ps, or drop sparse_tables to "
-                    "move full leaves")
+                    "sparse_tables with transport='inproc' requires the "
+                    "Python hub (native_ps=False): the C++ hub has no "
+                    "sparse inproc direct pair — use transport='socket' "
+                    "(native sparse is served over the S/V/U/X wire "
+                    "actions) or drop native_ps")
         # telemetry-driven adaptive aggregation (ISSUE 10), off by
         # default.  On: the trainer-owned hub merges queued commits
         # Adasum-style, scales each worker's commits by its live
@@ -349,11 +347,9 @@ class AsyncDistributedTrainer(Trainer):
         # attribute staleness per worker; pair with health_interval_s
         # for window-wall straggler detection too.  Python hub only
         self.adaptive = bool(adaptive)
-        if self.adaptive and native_ps:
-            raise ValueError(
-                "adaptive=True requires the Python hub (native_ps=False): "
-                "the C++ hub has no adaptive combiner or backpressure "
-                "handlers — drop native_ps, or drop adaptive")
+        # both hubs serve adaptive=True: the C++ hub runs the Adasum
+        # flat-combining merger and G/Y backpressure natively, with
+        # per-worker rates pushed from the Python AdaptiveRateController
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
@@ -375,17 +371,16 @@ class AsyncDistributedTrainer(Trainer):
         constructor.  ``shard_id`` tags a sharded hub's telemetry (None on
         the unsharded path — the exact pre-sharding series).  With sparse
         tables resolved for this run, each hub additionally learns its
-        sparse leaf positions (never added otherwise, so the C++ hub's
-        ctor — which has no such kwarg — stays reachable)."""
+        sparse leaf positions (never added otherwise — the off path
+        byte-parity pins never see the kwarg)."""
         kw = {"idle_timeout": self.ps_idle_timeout, "shard_id": shard_id,
               "replica_of": self.replica_of}
         sp = getattr(self, "_hub_sparse", None)
         if sp is not None:
             kw["sparse_leaves"] = sp.get(shard_id, ())
         if self.adaptive:
-            # only added when on, so the C++ hub's ctor (no such kwarg)
-            # stays reachable on the default path (and the native_ps +
-            # adaptive combination is already rejected at setup)
+            # only added when on, so the off path's zero-adaptive-
+            # machinery guarantee holds for either hub implementation
             kw["adaptive"] = True
         return kw
 

@@ -141,7 +141,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     HOT STANDBY of the primary at that address — it serves pulls
     immediately, tracks the primary's applied commits over the
     replication feed (wire action ``R``), and promotes itself behind the
-    clock fence when the primary dies.  Python hub only; with
+    clock fence when the primary dies.  Served by BOTH hubs (the C++
+    standby runs its feed thread native-side); with
     ``num_shards > 1`` it requires ``shard_index`` (one standby daemon
     per shard primary, pointed at THAT shard's address).
 
@@ -157,7 +158,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     merge queued commits Adasum-style, scale each worker's commits by
     its live staleness standing (driven by the health plane's detector
     events), and answer adaptive clients' reconnect hellos with
-    retry-after hints while a reconnect storm is live.  Python hub only;
+    retry-after hints while a reconnect storm is live.  Served by BOTH
+    hubs (the C++ hub runs the Adasum merger and backpressure natively);
     pair with trainers started with the matching ``adaptive=True``.
 
     Row-sparse embedding service (ISSUE 9): ``sparse_tables="auto"``
@@ -166,7 +168,7 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     the matching ``sparse_tables`` knob exchange only touched rows; an
     iterable names flat-leaf indices explicitly.  Both ends derive the
     same leaf set (and, sharded, the same row-range plan) from the same
-    model — nothing travels on the wire.  Python hub only.
+    model — nothing travels on the wire.  Served by BOTH hubs.
     """
     from distkeras_tpu.runtime.parameter_server import (
         ShardedParameterServer, shard_plan)
@@ -187,22 +189,11 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                 f"{model.spec.name!r} declares no sparse embedding tables")
     else:
         sparse_idx = tuple(sorted({int(i) for i in sparse_tables}))
-    if sparse_idx and native:
-        raise ValueError("sparse_tables requires the Python hub (drop "
-                         "native=True): the C++ hub has no sparse "
-                         "pull/commit handlers")
-    if adaptive and native:
-        raise ValueError("adaptive requires the Python hub (drop "
-                         "native=True): the C++ hub has no adaptive "
-                         "combiner or backpressure handlers")
     if shard_index is not None and not (0 <= int(shard_index) < num_shards):
         raise ValueError(f"shard_index={shard_index} out of range for "
                          f"num_shards={num_shards}")
     if replica_of is not None:
         replica_of = (str(replica_of[0]), int(replica_of[1]))
-        if native:
-            raise ValueError("replica_of requires the Python hub (drop "
-                             "native=True); the wire protocol is identical")
         if num_shards > 1 and shard_index is None:
             raise ValueError("replica_of with num_shards > 1 requires "
                              "shard_index: run one standby daemon per "
@@ -218,8 +209,6 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                       restore=restore if own_snapshots else False,
                       shard_id=shard_id)
         if hub_sparse:
-            # only added when sparse is actually on, so the C++ hub's
-            # ctor (no such kwarg) stays reachable on the dense path
             common["sparse_leaves"] = hub_sparse
         if native:
             from distkeras_tpu.runtime.native import (
@@ -228,10 +217,13 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
             native_mode = {"delta": MODE_DELTA, "adag": MODE_ADAG,
                            "dynsgd": MODE_DYNSGD}[mode]
             # the C++ hub binds all interfaces; host selection is
-            # Python-hub only
+            # Python-hub only.  Sparse tables, adaptive aggregation and
+            # hot-standby replication all run native-side (ISSUE 11)
             return NativeParameterServer(hub_weights, mode=native_mode,
                                          num_workers=num_workers,
                                          port=hub_port, elastic=elastic,
+                                         replica_of=replica_of,
+                                         adaptive=adaptive,
                                          **common)
         from distkeras_tpu.runtime.parameter_server import (
             ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer)
@@ -329,15 +321,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "this file as JSON lines; live view: "
                              "distkeras-top against a punchcard daemon")
     parser.add_argument("--sparse-tables", default=None, metavar="SPEC",
-                        help="row-sparse embedding service (Python hub "
-                             "only): 'auto' registers the model's declared "
+                        help="row-sparse embedding service (both hubs): "
+                             "'auto' registers the model's declared "
                              "EmbeddingTable leaves, or a comma-separated "
                              "list of flat-leaf indices; workers started "
                              "with the matching sparse_tables knob then "
                              "exchange only the rows each batch touches")
     parser.add_argument("--adaptive", action="store_true",
-                        help="telemetry-driven adaptive aggregation "
-                             "(Python hub only): merge queued commits "
+                        help="telemetry-driven adaptive aggregation (both "
+                             "hubs): merge queued commits "
                              "Adasum-style, scale each worker's commits "
                              "by its live staleness standing, and shed "
                              "reconnect storms with retry-after hints "
@@ -346,25 +338,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="start as a hot standby of the primary hub at "
                              "this address: serve pulls immediately, stream "
                              "its applied commits, promote on its death "
-                             "(Python hub only; sharded: one standby daemon "
+                             "(both hubs; sharded: one standby daemon "
                              "per shard, paired with --shard-index)")
     args = parser.parse_args(argv)
     if args.restore and not args.snapshot_dir:
         parser.error("--restore requires --snapshot-dir")
     if args.shard_index is not None and args.num_shards <= 1:
         parser.error("--shard-index requires --num-shards > 1")
-    if args.adaptive and args.native:
-        parser.error("--adaptive requires the Python hub (drop --native): "
-                     "the C++ hub has no adaptive combiner or backpressure "
-                     "handlers")
     if args.save_final and args.shard_index is not None:
         parser.error("--save-final needs the full center; a single-shard "
                      "process only holds its slice")
     replica_of = None
     if args.replica_of:
-        if args.native:
-            parser.error("--replica-of requires the Python hub (drop "
-                         "--native); the wire protocol is identical")
         if args.num_shards > 1 and args.shard_index is None:
             parser.error("--replica-of with --num-shards > 1 requires "
                          "--shard-index (one standby daemon per shard)")
@@ -375,9 +360,6 @@ def main(argv: Optional[List[str]] = None) -> None:
         replica_of = (host_part, int(port_part))
     sparse_tables: Optional[Any] = None
     if args.sparse_tables:
-        if args.native:
-            parser.error("--sparse-tables requires the Python hub (drop "
-                         "--native): the C++ hub has no sparse handlers")
         if args.sparse_tables == "auto":
             sparse_tables = "auto"
         else:

@@ -541,6 +541,27 @@ def encoded_tensors_size(arrays: Sequence[np.ndarray]) -> int:
     return 5 + sum(8 + np.asarray(a).nbytes for a in arrays)
 
 
+def max_request_payload(templates: Sequence[np.ndarray],
+                        sparse_leaves: Sequence[int] = ()) -> int:
+    """Largest VALID request payload a hub serving ``templates`` may
+    receive: per tensor the larger of the f32 blob (``4*size``) and the
+    int8 ``Q`` blob (``4 + size`` — bigger for scalar leaves), floored at
+    the control-frame allowance so a ``T`` announce / ``M`` health report
+    fits even when the center is tiny; with sparse tables, a sparse f32
+    commit touching every row additionally carries one int64 id blob per
+    table.  The ONE accounting both hubs receive against — the Python
+    hub's handler bound and the value ``runtime/native.py`` hands
+    ``dk_ps_create`` — so a garbage length prefix is rejected identically
+    by either implementation."""
+    arrays = [np.asarray(t) for t in templates]
+    dense = 5 + sum(8 + max(w.nbytes, 4 + w.size) for w in arrays)
+    bound = max(dense, CONTROL_PAYLOAD_MAX)
+    if sparse_leaves:
+        bound = max(bound, dense + sum(8 + 8 * arrays[i].shape[0]
+                                       for i in sparse_leaves))
+    return bound
+
+
 def tensor_frame_len(templates: Sequence[np.ndarray]) -> int:
     """Full on-the-wire size (8-byte header included) of one tensor frame
     carrying exactly ``templates``' payloads — the ``payload_hint`` every

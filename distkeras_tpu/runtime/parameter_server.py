@@ -817,25 +817,13 @@ class SocketParameterServer:
         # A shard hub computes this from ITS center subset, so per-shard
         # connections get per-shard-sized kernel buffers
         self._frame_bytes = net.tensor_frame_len(self.center)
-        # largest VALID payload a peer may declare.  Per tensor that is
-        # the larger of the f32 blob (4*size) and the int8 Q blob
-        # (4 + size — bigger for SCALAR leaves).  The handler receives
+        # largest VALID payload a peer may declare — the handler receives
         # against this bound, so a garbage length prefix is a typed
-        # ProtocolError instead of a 16 GiB bytearray.  Floored at the
-        # control-frame allowance so a T announce / M health report fits
-        # even when the center is tiny
-        self._max_payload = max(
-            5 + sum(8 + max(w.nbytes, 4 + w.size) for w in self.center),
-            net.CONTROL_PAYLOAD_MAX)
-        if self.sparse_leaves:
-            # a sparse f32 commit touching every row adds one int64 id
-            # blob (8 bytes/row + its prefix) per table on top of the
-            # dense commit's bound
-            self._max_payload = max(
-                self._max_payload,
-                5 + sum(8 + max(w.nbytes, 4 + w.size) for w in self.center)
-                + sum(8 + 8 * self.center[i].shape[0]
-                      for i in self.sparse_leaves))
+        # ProtocolError instead of a 16 GiB bytearray.  The accounting is
+        # SHARED with the C++ hub (net.max_request_payload), so both hub
+        # implementations reject the exact same oversized prefixes
+        self._max_payload = net.max_request_payload(self.center,
+                                                    self.sparse_leaves)
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
         # half-open liveness: a peer that dies without FIN used to park its
         # handler in recv() forever.  With idle_timeout set, a connection

@@ -847,12 +847,25 @@ def test_adaptive_off_constructs_zero_adaptive_machinery(
     assert trainer.history
 
 
-@pytest.mark.parametrize("trainer_name,pipeline", [
-    ("AsyncADAG", False),
-    ("AsyncDynSGD", True),  # pipelined: nonzero self-staleness scales
+def _native_mark():
+    from distkeras_tpu.runtime.native import build_error, native_available
+
+    return pytest.mark.skipif(not native_available(),
+                              reason=f"native PS unavailable: {build_error()}")
+
+
+# hub dimension (ISSUE 11): the C++ combiner's batch-of-one must equal
+# the plain apply too.  Tier-1 keeps the cheapest native cell (PR-6
+# convention); the second native cell rides the slow suite
+@pytest.mark.parametrize("trainer_name,pipeline,native", [
+    ("AsyncADAG", False, False),
+    ("AsyncDynSGD", True, False),  # pipelined: nonzero self-staleness scales
+    pytest.param("AsyncDynSGD", True, True, marks=_native_mark()),
+    pytest.param("AsyncADAG", False, True,
+                 marks=[_native_mark(), pytest.mark.slow]),
 ])
 def test_adaptive_on_uncontended_trajectory_bit_equal(trainer_name, pipeline,
-                                                      toy_dataset,
+                                                      native, toy_dataset,
                                                       fresh_health):
     """One worker, no contention, no events: adaptive=True must be
     bit-identical to adaptive=False — the combiner's batch-of-one apply
@@ -873,7 +886,7 @@ def test_adaptive_on_uncontended_trajectory_bit_equal(trainer_name, pipeline,
                       loss="categorical_crossentropy", batch_size=16,
                       num_epoch=2, num_workers=1, communication_window=4,
                       learning_rate=0.05, seed=0, pipeline=pipeline,
-                      adaptive=adaptive)
+                      adaptive=adaptive, native_ps=native)
         model = trainer.train(toy_dataset)
         return trainer.history, jax.tree.leaves(model.params)
 
@@ -892,13 +905,20 @@ def test_adaptive_trainer_guards(toy_dataset):
     spec = ModelSpec(name="mlp",
                      config={"hidden_sizes": (16,), "num_outputs": 2},
                      input_shape=(8,))
-    with pytest.raises(ValueError, match="adaptive.*Python hub"):
-        dk.AsyncADAG(Model.init(spec, seed=0),
-                     loss="categorical_crossentropy", batch_size=16,
-                     num_epoch=1, adaptive=True, native_ps=True)
-    with pytest.raises(ValueError, match="adaptive.*Python hub"):
-        start_parameter_server(Model.init(spec, seed=0), native=True,
-                               adaptive=True)
+    # adaptive + native is SERVED since ISSUE 11: the trainer constructs,
+    # and a standalone native adaptive hub starts and stops cleanly
+    dk.AsyncADAG(Model.init(spec, seed=0),
+                 loss="categorical_crossentropy", batch_size=16,
+                 num_epoch=1, adaptive=True, native_ps=True)
+    from distkeras_tpu.runtime.native import native_available
+
+    if native_available():
+        ps = start_parameter_server(Model.init(spec, seed=0), native=True,
+                                    adaptive=True, idle_timeout=None)
+        try:
+            assert ps.adaptive and ps.port > 0
+        finally:
+            ps.stop()
 
 
 def test_adaptive_trainer_end_to_end(toy_dataset, fresh_health):
@@ -946,8 +966,11 @@ def test_adaptive_inproc_trainer_end_to_end(toy_dataset, fresh_health):
     assert trainer.worker_errors == []
 
 
-def test_distkeras_ps_adaptive_flag_rejected_with_native():
+def test_distkeras_ps_adaptive_flag_composes_with_native():
+    """--adaptive --native is no longer a parser error (ISSUE 11): the
+    CLI reaches the model load (which fails on the nonexistent path,
+    proving the flag combination passed validation)."""
     from distkeras_tpu.runtime.launcher import main
 
-    with pytest.raises(SystemExit):
+    with pytest.raises(FileNotFoundError):
         main(["--model", "/nonexistent", "--native", "--adaptive"])

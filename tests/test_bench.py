@@ -44,6 +44,7 @@ def test_main_emits_diagnostic_line_on_failure(capsys, monkeypatch):
     assert rec["metric"] == "mnist_cnn_train_samples_per_sec_per_chip"
 
 
+@pytest.mark.slow  # tier-1 budget fix (PR 11): heaviest cells ride the full suite
 def test_mnist_bench_runs_on_cpu():
     sps, method = bench._bench_mnist_cnn(batch_size=8, num_batches=2, reps=1)
     assert sps > 0
@@ -58,6 +59,7 @@ def test_peak_flops_lookup():
     assert bench._peak_flops("Quantum Abacus 9000") is None
 
 
+@pytest.mark.slow  # tier-1 budget fix (PR 11): heaviest cells ride the full suite
 def test_decode_bench_runs_tiny_on_cpu():
     """The decode section (incl. the TRAINED speculative leg) at toy scale:
     every leg present, spread recorded, acceptance_rate a real fraction."""
@@ -593,3 +595,57 @@ def test_moe_acceptance_block_shape():
     assert out["top1_dense"]["dispatch_impl"] == "dense"
     assert out["top1_dense"]["dispatch_flops_pct"] > 0
     assert _np.isfinite(out["sorted_vs_dense_top1"])
+
+
+def test_native_features_acceptance_block_tripwires():
+    """The ISSUE-11 per-leg tripwires: native per-window wall must be
+    at-or-under the Python hub's, None-degrading (the PR-3 convention)
+    when either leg is missing, errored, or zero."""
+    out = {
+        "sparse_python": {"per_window_wall_ms": 40.0},
+        "sparse_native": {"per_window_wall_ms": 30.0},
+        "adaptive_python": {"per_window_wall_ms": 50.0},
+        "adaptive_native": {"per_window_wall_ms": 55.0},
+        "sparse_adaptive_python": {"error": "RuntimeError: boom"},
+        "sparse_adaptive_native": {"per_window_wall_ms": 30.0},
+    }
+    bench._native_features_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["sparse_native_vs_python"] == 0.75
+    assert acc["sparse_native_beats_python_ok"] is True
+    assert acc["adaptive_native_vs_python"] == 1.1
+    assert acc["adaptive_native_beats_python_ok"] is False
+    assert acc["sparse_adaptive_native_vs_python"] is None
+    assert acc["sparse_adaptive_native_beats_python_ok"] is None
+
+    # zero / missing denominators degrade to None, never ZeroDivision
+    out2 = {"sparse_python": {"per_window_wall_ms": 0.0},
+            "sparse_native": {"per_window_wall_ms": 1.0}}
+    bench._native_features_acceptance(out2)
+    assert out2["acceptance"]["sparse_native_beats_python_ok"] is None
+    out3 = {}
+    bench._native_features_acceptance(out3)
+    assert out3["acceptance"]["adaptive_native_beats_python_ok"] is None
+
+
+@pytest.mark.slow  # ~60-200s of real bench machinery on CPU
+def test_bench_async_native_features_tiny_e2e():
+    """The ISSUE-11 legs run end to end tiny: every feature combination
+    lands a wall number on BOTH hubs (or a recorded error, never a
+    crash), and the acceptance block carries one tripwire per leg."""
+    from distkeras_tpu.runtime.native import native_available
+
+    out = bench._bench_async_native_features(
+        workers=2, window=2, batch=8, windows_per_epoch=2, epochs=1,
+        rows=32, dim=4, fields=2)
+    acc = out["acceptance"]
+    for leg in ("sparse", "adaptive", "sparse_adaptive"):
+        for hub in ("python", "native"):
+            rec = out[f"{leg}_{hub}"]
+            assert isinstance(rec, dict)
+            assert "per_window_wall_ms" in rec or "error" in rec
+        assert f"{leg}_native_beats_python_ok" in acc
+        if native_available():
+            # tiny-shape wall is noisy — the tripwire may be False here
+            # (the real bench runs production shapes), but it must EXIST
+            assert acc[f"{leg}_native_vs_python"] is not None

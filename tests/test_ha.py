@@ -868,22 +868,22 @@ def test_launcher_replica_of_starts_a_tracking_standby():
     finally:
         replica.stop()
         primary.stop()
-    # native hubs have no replication feed: documented Python-only fallback
-    with pytest.raises(ValueError, match="Python hub"):
-        start_parameter_server(model, mode="delta", native=True,
-                               replica_of=("127.0.0.1", 1))
+    # native hubs run the replication feed too since ISSUE 11 (both
+    # sides); the cross-implementation drills live in test_native_ps.py
 
 
-def test_native_hub_rejects_replica_of_with_guidance():
+def test_native_hub_accepts_replica_of():
+    """replica_of on the C++ hub constructs a standby (ISSUE 11) — the
+    live feed/promotion drills ride tests/test_native_ps.py."""
     from distkeras_tpu.runtime.native import (MODE_DELTA,
                                               NativeParameterServer,
                                               native_available)
 
     if not native_available():
         pytest.skip("no C++ toolchain for the native hub")
-    with pytest.raises(NotImplementedError, match="Python hub"):
-        NativeParameterServer(_weights(), mode=MODE_DELTA,
-                              replica_of=("127.0.0.1", 1))
+    ps = NativeParameterServer(_weights(), mode=MODE_DELTA,
+                               replica_of=("127.0.0.1", 1))
+    assert ps.is_standby() and not ps.promoted
 
 
 def test_trainer_replica_knob_validation():
@@ -897,8 +897,6 @@ def test_trainer_replica_knob_validation():
         dk.AsyncADAG(spec, ps_address=("h", 1), replica_of=("h", 2))
     with pytest.raises(ValueError, match="num_shards"):
         dk.AsyncADAG(spec, num_shards=2, replica_of=("h", 2))
-    with pytest.raises(ValueError, match="Python hub"):
-        dk.AsyncADAG(spec, native_ps=True, replica_of=("h", 2))
     with pytest.raises(ValueError, match="per shard"):
         dk.AsyncADAG(spec, ps_address=[("h", 1), ("h", 2)],
                      ps_failover=[("h", 3)])

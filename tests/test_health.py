@@ -1220,9 +1220,8 @@ def test_trainer_health_interval_validation():
     with pytest.raises(ValueError, match="health_interval_s"):
         dk.AsyncADAG(model, loss="categorical_crossentropy",
                      health_interval_s=0.0)
-    with pytest.raises(ValueError, match="Python hub"):
-        dk.AsyncADAG(model, loss="categorical_crossentropy",
-                     native_ps=True, health_interval_s=1.0)
+    # native_ps + health_interval_s over sockets is served since ISSUE 11
+    # (the C++ hub ingests action-M reports); no guard to pin here
 
 
 def test_trainer_with_health_interval_reports_and_detects(fresh_health,

@@ -45,6 +45,7 @@ def test_spec_dict_roundtrip():
     assert ModelSpec.from_dict(spec.to_dict()) == spec
 
 
+@pytest.mark.slow  # tier-1 budget fix (PR 11): heaviest cells ride the full suite
 def test_transformer_remat_matches_non_remat():
     """remat=True must be a pure memory trade: identical loss and grads."""
     import jax
@@ -139,6 +140,7 @@ def test_model_summary():
     assert f"{want:,} params" in s
 
 
+@pytest.mark.slow  # tier-1 budget fix (PR 11): heaviest cells ride the full suite
 def test_compute_dtype_policy_parity_classic_family():
     """bf16-compute CNN/MLP/ResNet: identical float32 param trees (the
     policy touches activations only), logits within bf16 rounding of the

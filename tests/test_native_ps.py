@@ -21,6 +21,17 @@ pytestmark = pytest.mark.skipif(
     not native_available(), reason=f"native PS unavailable: {build_error()}")
 
 
+@pytest.fixture
+def fresh_health():
+    """Clean process-default collector/monitor (the native wrapper's poll
+    thread folds wire reports into these)."""
+    from distkeras_tpu.observability import health as health_mod
+
+    health_mod.reset_default()
+    yield health_mod
+    health_mod.reset_default()
+
+
 def _weights():
     return [np.zeros((2, 2), np.float32), np.zeros((3,), np.float32)]
 
@@ -232,3 +243,514 @@ def test_native_async_downpour_trains_with_int8_commits(toy_dataset):
     acc = AccuracyEvaluator(prediction_col="prediction_index",
                             label_col="label_index").evaluate(ds)
     assert acc > 0.9, f"native int8-commit training underperformed: {acc}"
+
+
+# -- ISSUE 11: feature parity (sparse, adaptive, replication, M/G/Y) -----------
+
+def _sparse_weights():
+    return [np.zeros((6, 3), np.float32), np.zeros((4,), np.float32)]
+
+
+def _native(weights=None, **kw):
+    return NativeParameterServer(weights if weights is not None
+                                 else _sparse_weights(), **kw)
+
+
+def test_native_sparse_pull_commit_matches_python_hub():
+    """S/V/U exchange against both hubs with identical client traffic:
+    partial-touch row pulls and commits land bit-identical centers."""
+    from distkeras_tpu.runtime.parameter_server import DeltaParameterServer
+
+    rng = np.random.default_rng(3)
+    ids_seq = [np.array([0, 2, 5], np.int64), np.array([1, 2], np.int64),
+               np.array([3], np.int64)]
+
+    def drive(ps):
+        ps.start()
+        try:
+            with PSClient("127.0.0.1", ps.port,
+                          templates=_sparse_weights(),
+                          sparse_leaves=[0]) as c:
+                c.pull()  # full seed
+                for ids in ids_seq:
+                    c.pull_nowait(sparse_rows=[ids])
+                    c.wait_weights()
+                    delta = [np.zeros((6, 3), np.float32),
+                             rng.normal(size=(4,)).astype(np.float32)]
+                    delta[0][ids] = rng.normal(
+                        size=(ids.size, 3)).astype(np.float32)
+                    c.commit(delta, sparse_rows=[ids])
+                c.drain()
+                return c.pull()
+        finally:
+            ps.stop()
+
+    rng = np.random.default_rng(3)
+    w_native = drive(_native(mode=MODE_DELTA, sparse_leaves=[0]))
+    rng = np.random.default_rng(3)
+    w_python = drive(DeltaParameterServer(_sparse_weights(),
+                                          sparse_leaves=[0]))
+    for a, b in zip(w_native, w_python):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_sparse_int8_commit_matches_python_hub():
+    """X (int8 row-block) commits dequantize identically on both hubs."""
+    from distkeras_tpu.runtime.parameter_server import ADAGParameterServer
+
+    ids = np.array([1, 4], np.int64)
+
+    def drive(ps):
+        ps.start()
+        try:
+            with PSClient("127.0.0.1", ps.port, templates=_sparse_weights(),
+                          sparse_leaves=[0], compress="int8") as c:
+                rng = np.random.default_rng(9)
+                c.pull()
+                for _ in range(3):
+                    delta = [np.zeros((6, 3), np.float32),
+                             rng.normal(size=(4,)).astype(np.float32)]
+                    delta[0][ids] = rng.normal(size=(2, 3)).astype(np.float32)
+                    c.commit(delta, sparse_rows=[ids])
+                return c.pull()
+        finally:
+            ps.stop()
+
+    w_native = drive(_native(mode=MODE_ADAG, num_workers=2,
+                             sparse_leaves=[0]))
+    w_python = drive(ADAGParameterServer(_sparse_weights(), num_workers=2,
+                                         sparse_leaves=[0]))
+    for a, b in zip(w_native, w_python):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_sparse_rejects_bad_row_ids():
+    """Out-of-bounds / unsorted id blobs drop the connection (the Python
+    hub's ProtocolError semantics) and the hub survives for new peers."""
+    ps = _native(mode=MODE_DELTA, sparse_leaves=[0])
+    ps.start()
+    try:
+        from distkeras_tpu.runtime import networking as net
+
+        for bad in (np.array([7], np.int64),      # out of range
+                    np.array([3, 1], np.int64),   # unsorted
+                    np.array([2, 2], np.int64)):  # duplicate
+            sock = net.connect("127.0.0.1", ps.port)
+            net.send_tensors(sock, net.ACTION_SPARSE_PULL, [bad])
+            with pytest.raises((ConnectionError, ValueError)):
+                net.recv_tensors(sock)
+            sock.close()
+        # hub still serves a well-formed peer
+        with PSClient("127.0.0.1", ps.port, templates=_sparse_weights(),
+                      sparse_leaves=[0]) as c:
+            c.pull()
+    finally:
+        ps.stop()
+
+
+def test_native_sparse_telemetry_established_names():
+    """sync_telemetry surfaces sparse counters under the SAME names the
+    Python hub emits (ps.sparse_rows_pulled / _committed / wire saved)."""
+    from distkeras_tpu import observability as obs
+
+    ps = _native(mode=MODE_DELTA, sparse_leaves=[0])
+    ps.start()
+    obs.enable()
+    obs.reset()
+    try:
+        ids = np.array([0, 3], np.int64)
+        with PSClient("127.0.0.1", ps.port, templates=_sparse_weights(),
+                      sparse_leaves=[0]) as c:
+            c.pull()
+            c.pull_nowait(sparse_rows=[ids])
+            c.wait_weights()
+            delta = [np.zeros((6, 3), np.float32), np.ones((4,), np.float32)]
+            delta[0][ids] = 1.0
+            c.commit(delta, sparse_rows=[ids])
+            c.drain()
+        ps.sync_telemetry()
+        counters = obs.snapshot()["counters"]
+        assert counters.get("ps.sparse_rows_pulled") == 2.0
+        assert counters.get("ps.sparse_rows_committed") == 2.0
+        assert counters.get("ps.sparse_wire_bytes_saved", 0) > 0
+    finally:
+        obs.reset()
+        obs.disable()
+        ps.stop()
+
+
+def test_native_adaptive_batch_of_one_bit_equal_plain():
+    """Uncontended adaptive applies are bit-identical to adaptive=False —
+    the C++ combiner's batch-of-one IS the plain apply (the Python hub's
+    pinned property, extended to the native cell)."""
+    def drive(adaptive):
+        ps = _native(mode=MODE_DYNSGD, adaptive=adaptive)
+        ps.start()
+        try:
+            rng = np.random.default_rng(11)
+            with PSClient("127.0.0.1", ps.port,
+                          templates=_sparse_weights()) as c:
+                for i in range(6):
+                    c.pull()
+                    c.commit([rng.normal(size=(6, 3)).astype(np.float32),
+                              rng.normal(size=(4,)).astype(np.float32)])
+                return c.pull()
+        finally:
+            ps.stop()
+
+    for a, b in zip(drive(True), drive(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_adaptive_concurrent_commits_merge_and_advance_clock():
+    """Contended adaptive commits flow through the flat-combining merger:
+    every commit lands (num_updates == commits), the clock advances by
+    batch size, and merged batches are visible in stats."""
+    ps = _native([np.zeros((64,), np.float32)], mode=MODE_DELTA,
+                 adaptive=True)
+    ps.start()
+    n_workers, n_commits = 6, 30
+
+    def work(_):
+        with PSClient("127.0.0.1", ps.port,
+                      templates=[np.zeros((64,), np.float32)]) as c:
+            for _ in range(n_commits):
+                c.pull()
+                c.commit([np.zeros((64,), np.float32)])
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = ps.stats()
+        assert ps.num_updates == n_workers * n_commits
+        assert st["clock"] == n_workers * n_commits
+        assert st["commits"] == n_workers * n_commits
+        assert 1 <= st["merge_batches"] <= n_workers * n_commits
+        assert st["max_merge_batch"] >= 1
+    finally:
+        ps.stop()
+
+
+def test_native_adaptive_rate_scale_applies():
+    """A pushed per-worker rate scales that worker's commits in the C++
+    apply path (the AdaptiveRateController -> dk_ps_set_rate_scale
+    bridge), and an expired verdict reads as 1.0."""
+    w = [np.zeros((4,), np.float32)]
+    ps = _native(w, mode=MODE_DELTA, adaptive=True)
+    ps.start()
+    try:
+        # worker 7 scaled to 0.5 for a generous hold
+        ps._lib.dk_ps_set_rate_scale(ps._handle, 7, 0.5,
+                                     ps.time_ns() + int(60e9))
+        from distkeras_tpu.observability import distributed as dtrace
+
+        ctx = dtrace.TraceContext(job_id="j", worker_id=7, span_id=1)
+        with PSClient("127.0.0.1", ps.port, templates=w,
+                      trace_context=ctx) as c:
+            c.pull()
+            c.commit([np.ones((4,), np.float32)])
+        np.testing.assert_allclose(ps.get_weights()[0], np.full((4,), 0.5))
+        # expired verdict: back to 1.0
+        ps._lib.dk_ps_set_rate_scale(ps._handle, 7, 0.25, ps.time_ns() - 1)
+        with PSClient("127.0.0.1", ps.port, templates=w,
+                      trace_context=ctx) as c:
+            c.pull()
+            c.commit([np.ones((4,), np.float32)])
+        np.testing.assert_allclose(ps.get_weights()[0], np.full((4,), 1.5))
+    finally:
+        ps.stop()
+
+
+def test_native_answers_reconnect_hello():
+    """Every native hub answers G with a Y hint: 0 outside a storm (and
+    always 0 on a non-adaptive hub); an adaptive hub in a live storm
+    hands out increasing slots and admits announcers that already waited
+    (waits_taken > 0)."""
+    from distkeras_tpu.runtime import networking as net
+
+    def hello(port, waits=0):
+        sock = net.connect("127.0.0.1", port)
+        try:
+            net.send_frame(sock, net.encode_reconnect_payload(waits))
+            action, blobs = net.recv_tensors(sock)
+            assert action == net.ACTION_RETRY
+            return net.decode_retry_payload(blobs)
+        finally:
+            sock.close()
+
+    plain = _native(mode=MODE_DELTA)
+    plain.start()
+    try:
+        assert hello(plain.port) == 0
+    finally:
+        plain.stop()
+
+    ps = _native(mode=MODE_DELTA, adaptive=True)
+    ps.start()
+    try:
+        # tight storm thresholds so three hellos arm shedding
+        ps._lib.dk_ps_set_storm_params(ps._handle, 3, 5000, 3000, 50, 2000)
+        hints = [hello(ps.port) for _ in range(5)]
+        assert hints[0] == 0 and hints[1] == 0  # below the storm threshold
+        nonzero = [h for h in hints if h > 0]
+        assert nonzero, hints
+        assert nonzero == sorted(nonzero)  # later slots, spread in time
+        assert hello(ps.port, waits=1) == 0  # waited its slot: admitted
+        assert ps.backpressure_hints == len(nonzero)
+    finally:
+        ps.stop()
+
+
+def test_native_health_reports_fold_into_collector(fresh_health):
+    """Action-M reports against the native hub land in the process
+    HealthCollector via the wrapper's drain (wire health reporting is
+    hub-implementation-agnostic)."""
+    import time
+
+    from distkeras_tpu.observability import health as health_mod
+
+    ps = _native(mode=MODE_DELTA)
+    ps.start()
+    try:
+        with PSClient("127.0.0.1", ps.port,
+                      templates=_sparse_weights()) as c:
+            c.report_health({"worker": "3", "windows": 4,
+                             "window_wall_ms": {"mean": 12.0, "last": 11.0,
+                                                "count": 4},
+                             "reconnects_total": 0})
+            c.drain()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if "3" in health_mod.collector().workers():
+                break
+            time.sleep(0.05)
+        assert "3" in health_mod.collector().workers()
+    finally:
+        ps.stop()
+
+
+def test_native_plain_client_bytes_identical_vs_python_hub():
+    """THE wire-compat pin (ISSUE 11): an un-upgraded client's byte
+    stream against a native sparse+adaptive hub is identical to its
+    stream against the Python hub, and contains no S/V/U/X frame."""
+    from distkeras_tpu.runtime import networking as net
+    from distkeras_tpu.runtime.parameter_server import DeltaParameterServer
+
+    t = _sparse_weights()
+
+    def session_bytes(port):
+        with PSClient("127.0.0.1", port, templates=t) as c:
+            class _Rec:
+                def __init__(self, sock):
+                    self._sock = sock
+                    self.tx = bytearray()
+
+                def sendall(self, data):
+                    self.tx += bytes(data)
+                    return self._sock.sendall(data)
+
+                def __getattr__(self, name):
+                    return getattr(self._sock, name)
+
+            rec = _Rec(c.sock)
+            c.sock = rec
+            c.pull()
+            c.commit([np.full_like(a, 0.5) for a in t])
+            c.pull()
+            c.drain()
+        return bytes(rec.tx)
+
+    python_hub = DeltaParameterServer(t, idle_timeout=None)
+    python_hub.start()
+    native_hub = _native(mode=MODE_DELTA, sparse_leaves=[0], adaptive=True)
+    native_hub.start()
+    try:
+        base = session_bytes(python_hub.port)
+        against_native = session_bytes(native_hub.port)
+    finally:
+        python_hub.stop()
+        native_hub.stop()
+    assert base == against_native
+    i = 0
+    while i < len(base):
+        n = int.from_bytes(base[i:i + 8], "big")
+        assert base[i + 8:i + 9] not in (net.ACTION_SPARSE_PULL,
+                                         net.ACTION_SPARSE_WEIGHTS,
+                                         net.ACTION_SPARSE_COMMIT,
+                                         net.ACTION_SPARSE_QCOMMIT)
+        i += 8 + n
+
+
+# -- replication (native primary / native standby) -----------------------------
+
+def _feed_pair(primary_native, standby_native):
+    from distkeras_tpu.runtime.parameter_server import DeltaParameterServer
+
+    t = _sparse_weights()
+    if primary_native:
+        prim = _native(mode=MODE_DELTA)
+    else:
+        prim = DeltaParameterServer(t, idle_timeout=None)
+    prim.start()
+    if standby_native:
+        stand = _native(mode=MODE_DELTA,
+                        replica_of=("127.0.0.1", prim.port))
+    else:
+        stand = DeltaParameterServer(t, idle_timeout=None,
+                                     replica_of=("127.0.0.1", prim.port))
+    stand.start()
+    return prim, stand
+
+
+@pytest.mark.parametrize("primary_native,standby_native", [
+    (True, False),
+    pytest.param(False, True, marks=pytest.mark.slow),
+    pytest.param(True, True, marks=pytest.mark.slow),
+])
+def test_native_replication_centers_track(primary_native, standby_native):
+    """Hub implementations mix freely across the R feed: the standby's
+    center tracks the primary bit for bit after each acked commit."""
+    import time
+
+    prim, stand = _feed_pair(primary_native, standby_native)
+    t = _sparse_weights()
+    try:
+        assert stand.wait_synced(timeout=10)
+        rng = np.random.default_rng(0)
+        with PSClient("127.0.0.1", prim.port, templates=t) as c:
+            for _ in range(4):
+                c.pull()
+                c.commit([rng.normal(size=(6, 3)).astype(np.float32),
+                          rng.normal(size=(4,)).astype(np.float32)])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if stand.num_updates >= 4:
+                break
+            time.sleep(0.05)
+        for a, b in zip(prim.get_weights(), stand.get_weights()):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        stand.stop()
+        prim.stop()
+
+
+def test_native_standby_promotes_on_primary_death():
+    """A native standby whose primary dies promotes itself behind the
+    clock fence within its retry budget, then serves commits."""
+    import time
+
+    prim, stand = _feed_pair(primary_native=False, standby_native=True)
+    t = _sparse_weights()
+    try:
+        assert stand.wait_synced(timeout=10)
+        with PSClient("127.0.0.1", prim.port, templates=t) as c:
+            c.pull()
+            c.commit([np.ones((6, 3), np.float32), np.ones((4,), np.float32)])
+        time.sleep(0.3)
+        prim.kill()
+        deadline = time.time() + 20
+        while time.time() < deadline and not stand.promoted:
+            time.sleep(0.1)
+        assert stand.promoted
+        assert not stand.is_standby()
+        assert stand.promoted_at_clock is not None
+        # promoted standby serves commits like any hub
+        with PSClient("127.0.0.1", stand.port, templates=t) as c:
+            c.pull()
+            c.commit([np.ones((6, 3), np.float32), np.ones((4,), np.float32)])
+        np.testing.assert_allclose(stand.get_weights()[1], np.full((4,), 2.0))
+    finally:
+        stand.stop()
+        prim.stop()
+
+
+def test_native_never_synced_standby_refuses_traffic():
+    """Pulls and commits against a native standby that has never synced
+    drop the connection (no job state to serve or take over) — and the
+    inproc pair raises the Python hub's errors."""
+    # primary address that never answers: a bound-but-unserved port
+    import socket as socket_mod
+
+    placeholder = socket_mod.socket()
+    placeholder.bind(("127.0.0.1", 0))
+    dead_port = placeholder.getsockname()[1]
+    placeholder.close()
+    stand = _native(mode=MODE_DELTA, replica_of=("127.0.0.1", dead_port))
+    stand.start()
+    t = _sparse_weights()
+    try:
+        with pytest.raises((ConnectionError, ValueError, OSError)):
+            with PSClient("127.0.0.1", stand.port, templates=t) as c:
+                c.pull()
+        with pytest.raises(RuntimeError, match="never-synced"):
+            stand.pull_direct()
+        with pytest.raises(RuntimeError, match="never-synced"):
+            stand.commit_direct([np.zeros((6, 3), np.float32),
+                                 np.zeros((4,), np.float32)], 0)
+    finally:
+        stand.stop()
+
+
+# -- guidance + hygiene --------------------------------------------------------
+
+def test_not_implemented_messages_name_exact_combo():
+    """The two remaining NotImplementedError branches name the EXACT flag
+    combination that still requires the Python hub (ISSUE 11 satellite:
+    message accuracy is pinned, not vibes)."""
+    ps = _native(mode=MODE_DELTA, sparse_leaves=[0])
+    for method, args in (("pull_sparse_direct", ([np.array([0])],)),
+                         ("commit_sparse_direct", ([], 0))):
+        with pytest.raises(NotImplementedError) as ei:
+            getattr(ps, method)(*args)
+        msg = str(ei.value)
+        assert "sparse_tables" in msg
+        assert "transport='inproc'" in msg
+        assert "native_ps" in msg
+        assert "socket" in msg  # names the supported alternative
+
+
+def test_trainer_guard_only_rejects_inproc_sparse_native(toy_dataset):
+    """The five Async* trainers accept every native feature combination
+    except sparse+inproc — the one genuinely unported path."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.base import Model, ModelSpec
+
+    spec = ModelSpec(name="mlp",
+                     config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(8,))
+    # allowed: adaptive, health reporting, sparse over sockets, replica_of
+    dk.AsyncADAG(Model.init(spec, seed=0), loss="categorical_crossentropy",
+                 native_ps=True, adaptive=True, health_interval_s=1.0,
+                 sparse_tables=(0,))
+    with pytest.raises(ValueError, match="inproc"):
+        dk.AsyncADAG(Model.init(spec, seed=0),
+                     loss="categorical_crossentropy", native_ps=True,
+                     transport="inproc", sparse_tables=(0,))
+
+
+def test_native_build_is_warning_clean():
+    """Build hygiene (ISSUE 11 satellite): the growing C++ surface must
+    compile with -Wall -Wextra -Werror — a warning is a failed test, not
+    line noise."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this container")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from distkeras_tpu.runtime.native import BUILD_FLAGS
+
+    with tempfile.TemporaryDirectory() as td:
+        for src in ("ps_server.cpp", "data_loader.cpp"):
+            proc = subprocess.run(
+                ["g++"] + BUILD_FLAGS + ["-Wall", "-Wextra", "-Werror",
+                 os.path.join(root, "native", src),
+                 "-o", os.path.join(td, src + ".so")],
+                capture_output=True, text=True, timeout=300)
+            assert proc.returncode == 0, f"{src}:\n{proc.stderr}"

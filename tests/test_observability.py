@@ -885,6 +885,24 @@ def test_package_is_lint_clean(package):
             f"{os.path.basename(path)}: unused imports {unused}"
 
 
+@pytest.mark.parametrize("module", ["streaming.py", "job_deployment.py"])
+def test_runtime_stragglers_lint_clean_named(module):
+    """Satellite (PR 11): the last runtime modules named by the issue —
+    streaming.py and job_deployment.py — get their own NAMED lint cells
+    so a future scoping change to the package-level sweep can never
+    silently drop them (the package cell scans by listdir; this one pins
+    the two files by name)."""
+    import os
+    import py_compile
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "distkeras_tpu", "runtime", module)
+    assert os.path.exists(path), f"{module} moved without updating the guard"
+    py_compile.compile(path, doraise=True)
+    unused = _ast_unused_imports(path)
+    assert not unused, f"{module}: unused imports {unused}"
+
+
 def test_telemetry_disabled_leaves_async_run_unrecorded(toy_dataset):
     """Disabled-by-default contract: the instrumented async path records
     nothing unless enabled (and still trains correctly)."""

@@ -552,9 +552,32 @@ def _ctr_trainer(spec, sparse, **kw):
     return AsyncADAG(Model.init(spec, seed=0), **defaults)
 
 
-@pytest.mark.parametrize("compress", [None, "int8"])
-@pytest.mark.parametrize("pipeline,epochs", [(True, 1), (False, 2)])
-def test_sparse_vs_dense_full_touch_bit_parity(compress, pipeline, epochs):
+def _native_mark():
+    from distkeras_tpu.runtime.native import build_error, native_available
+
+    return pytest.mark.skipif(not native_available(),
+                              reason=f"native PS unavailable: {build_error()}")
+
+
+# hub dimension (ISSUE 11): the C++ hub serves the sparse wire plane, so
+# THE acceptance pin runs against both implementations.  Tier-1 keeps the
+# cheapest native cell (PR-6 convention); the rest of the native matrix
+# rides the slow suite
+@pytest.mark.parametrize("compress,pipeline,epochs,hub", [
+    (None, True, 1, "python"),
+    pytest.param(None, False, 2, "python", marks=pytest.mark.slow),
+    ("int8", True, 1, "python"),
+    pytest.param("int8", False, 2, "python", marks=pytest.mark.slow),
+    pytest.param(None, True, 1, "native", marks=_native_mark()),
+    pytest.param("int8", True, 1, "native",
+                 marks=[_native_mark(), pytest.mark.slow]),
+    pytest.param(None, False, 2, "native",
+                 marks=[_native_mark(), pytest.mark.slow]),
+    pytest.param("int8", False, 2, "native",
+                 marks=[_native_mark(), pytest.mark.slow]),
+])
+def test_sparse_vs_dense_full_touch_bit_parity(compress, pipeline, epochs,
+                                               hub):
     """THE acceptance pin: a 1-worker run whose every window touches every
     row lands bit-identical final weights sparse vs dense (full-touch row
     gathers carry exactly the dense payload; the hub applies the same
@@ -576,7 +599,8 @@ def test_sparse_vs_dense_full_touch_bit_parity(compress, pipeline, epochs):
     finals = []
     for sparse in (True, False):
         tr = _ctr_trainer(spec, sparse, compress_commits=compress,
-                          pipeline=pipeline, num_epoch=epochs)
+                          pipeline=pipeline, num_epoch=epochs,
+                          native_ps=(hub == "native"))
         model = tr.train(ds, shuffle=False)
         finals.append(jax.tree.leaves(model.params))
     for a, b in zip(*finals):
@@ -704,9 +728,14 @@ def test_sparse_knob_validation():
     from distkeras_tpu.runtime.async_trainer import AsyncADAG
 
     spec = ctr_embedding_spec(8, dim=4, fields=2)
-    with pytest.raises(ValueError, match="native_ps"):
+    # sparse + native over SOCKETS is served since ISSUE 11 — only the
+    # inproc combination still needs the Python hub, and the guard says so
+    AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
+              native_ps=True, loss="categorical_crossentropy")
+    with pytest.raises(ValueError, match="inproc"):
         AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
-                  native_ps=True)
+                  native_ps=True, transport="inproc",
+                  loss="categorical_crossentropy")
     with pytest.raises(ValueError, match="inproc"):
         tr = AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
                        transport="inproc", num_shards=2,
