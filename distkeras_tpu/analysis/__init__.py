@@ -1,0 +1,26 @@
+"""``distkeras_tpu.analysis`` — the project-aware static-analysis suite
+behind ``distkeras-lint`` (ISSUE 12).
+
+Four project-specific passes plus the consolidated F401 sweep:
+
+- :mod:`~distkeras_tpu.analysis.lock_order` — lock-acquisition graph
+  over ``runtime/`` + ``observability/`` checked against the declared
+  :mod:`~distkeras_tpu.analysis.lock_manifest`;
+- :mod:`~distkeras_tpu.analysis.blocking` — blocking calls
+  (``send*``/``recv*``/``time.sleep``/``Thread.join``/``subprocess``/
+  ``.result()``) lexically inside held-lock regions;
+- :mod:`~distkeras_tpu.analysis.wire_parity` — ``ACTION_*`` registry vs
+  the C++ hub's char-literal dispatch, plus NotImplementedError knob
+  staleness;
+- :mod:`~distkeras_tpu.analysis.telemetry` — every metric/span name
+  literal checked against
+  :mod:`~distkeras_tpu.analysis.telemetry_registry`;
+- :mod:`~distkeras_tpu.analysis.unused_imports` — the one F401
+  implementation the per-package test cells delegate to.
+
+``tests/test_analysis.py`` runs the full suite over the repo as a tier-1
+gate; the console script is ``distkeras-lint`` (see
+:mod:`~distkeras_tpu.analysis.cli`).
+"""
+
+from distkeras_tpu.analysis.core import Finding  # noqa: F401  (re-export)

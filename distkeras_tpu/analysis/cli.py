@@ -1,0 +1,116 @@
+"""``distkeras-lint`` — run the project-aware static-analysis suite.
+
+Usage::
+
+    distkeras-lint [--root DIR] [--json] [--pass NAME ...] [--dump-graph]
+
+Exit code 0 when the tree is clean, 1 when any pass has findings (and 2
+on usage errors).  ``--json`` emits a machine-readable report; the
+default output groups findings by pass.  ``--dump-graph`` prints the
+discovered lock-acquisition graph (the input to the lock-order check) —
+the tool to run when extending ``lock_manifest.LOCK_ORDER``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from distkeras_tpu.analysis import (blocking, lock_order, telemetry,
+                                    unused_imports, wire_parity)
+from distkeras_tpu.analysis.core import (RULES, Finding, load_sources,
+                                         python_files, repo_root)
+
+#: one pass per rule id — the vocabulary lives in core.RULES so the
+#: annotation grammar and the CLI can never drift apart
+PASSES = RULES
+
+
+def run_all(root: Optional[str] = None,
+            passes: Optional[Sequence[str]] = None
+            ) -> Dict[str, List[Finding]]:
+    """Run the requested passes (default: all), parsing each source file
+    exactly once — the hub subset (lock passes) aliases into the full
+    package set, so the gate's cost is one parse of the tree."""
+    root = root or repo_root()
+    names = list(passes) if passes else list(PASSES)
+    pkg_sources = hub_sources = None
+    if any(n in names for n in ("wire-parity", "telemetry", "lock-order",
+                                "blocking")):
+        pkg_sources = load_sources(python_files(root, ("distkeras_tpu",),
+                                                extra=("bench.py",)))
+        hub_paths = set(python_files(root, lock_order.DEFAULT_SUBDIRS))
+        hub_sources = {p: s for p, s in pkg_sources.items()
+                       if p in hub_paths}
+    runners = {
+        "lock-order": lambda: lock_order.run(root, hub_sources),
+        "blocking": lambda: blocking.run(root, hub_sources),
+        "wire-parity": lambda: wire_parity.run(root, pkg_sources),
+        "telemetry": lambda: telemetry.run(root, pkg_sources),
+        # package files reuse the shared parse; tests/ etc. parse here
+        "unused-import": lambda: unused_imports.run(root, pkg_sources),
+    }
+    return {name: runners[name]() for name in names}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="distkeras-lint",
+        description="project-aware static analysis: lock order, blocking "
+                    "calls under locks, Python<->C++ wire-action parity, "
+                    "telemetry-name registry, unused imports")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: the checkout this "
+                             "package lives in)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings report")
+    parser.add_argument("--pass", action="append", dest="passes",
+                        choices=list(PASSES), default=None,
+                        help="run only this pass (repeatable)")
+    parser.add_argument("--dump-graph", action="store_true",
+                        help="print the discovered lock-acquisition graph "
+                             "and exit")
+    args = parser.parse_args(argv)
+    root = args.root or repo_root()
+
+    if args.dump_graph:
+        sources = load_sources(
+            python_files(root, lock_order.DEFAULT_SUBDIRS))
+        edges = lock_order.build_graph(sources, root)
+        for (src, dst), locs in sorted(edges.items()):
+            print(f"{src} -> {dst}")
+            for path, line, via in locs[:4]:
+                print(f"    {path}:{line} ({via})")
+        return 0
+
+    t0 = time.perf_counter()
+    results = run_all(root, args.passes)
+    elapsed = time.perf_counter() - t0
+    total = sum(len(v) for v in results.values())
+
+    if args.as_json:
+        print(json.dumps({
+            "root": root,
+            "elapsed_s": round(elapsed, 3),
+            "total": total,
+            "findings": {name: [f.to_dict() for f in fs]
+                         for name, fs in results.items()},
+        }, indent=2))
+        return 1 if total else 0
+
+    for name in results:
+        fs = results[name]
+        status = "clean" if not fs else f"{len(fs)} finding(s)"
+        print(f"[{name}] {status}")
+        for f in fs:
+            print(f"  {f}")
+    print(f"distkeras-lint: {total} finding(s) across "
+          f"{len(results)} pass(es) in {elapsed:.2f}s")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - console entry
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""The declared lock-order manifest for ``runtime/`` + ``observability/``.
+
+``distkeras-lint``'s lock-order pass discovers every ``threading.Lock``/
+``RLock``/``Condition`` attribute in the analyzed modules, builds the
+acquisition graph (lock A held while acquiring lock B — from nested
+``with`` blocks and one level of intra-module call resolution), and then
+checks that graph against THIS file:
+
+- every edge must be acyclic, and
+- every edge whose endpoints both appear in :data:`LOCK_ORDER` must point
+  forward in that list (outermost first).
+
+A lock that participates in any acquisition edge must be listed here —
+adding a new nested acquisition forces an explicit ordering decision
+instead of a reviewer's memory (the PR-8 ``monitor()`` deadlock shipped
+precisely because no such decision existed).  Locks that are only ever
+held alone need no entry.
+
+Node naming: ``ClassName._attr`` for instance locks (named by the class
+that DEFINES the attribute, so subclass acquisitions unify), and
+``module._name`` for module-level locks (e.g. ``health._default_lock``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: Outermost-first global acquisition order.  An observed edge A->B with
+#: both ends listed must satisfy index(A) < index(B).
+LOCK_ORDER = [
+    # coordinator / snapshot plane (holds center locks via its cut)
+    "SnapshotSetCoordinator._save_lock",
+    "HubSnapshotter._save_lock",
+    # adaptive combiner: drain owner applies batches into the center
+    "_AdaptiveCombiner._drain",
+    "_AdaptiveCombiner._qlock",
+    # replication feed: attach full-syncs under the hub's center lock
+    "ReplicationFeed._lock",
+    # the center lock itself
+    "SocketParameterServer._lock",
+    # hub side-structures, only ever leaves under the center/feed locks
+    "SocketParameterServer._conn_lock",
+    "SocketParameterServer._member_lock",
+    "SocketParameterServer._feed_lock",
+    "SocketParameterServer._bp_lock",
+    # client-side I/O serializer
+    "PSClient._io_lock",
+    # native hub wrapper
+    "NativeParameterServer._stats_lock",
+    "NativeParameterServer._drain_lock",
+    # health plane
+    "health._default_lock",  # lint: telemetry-ok lock node name, not a metric
+    "HealthMonitor._lock",
+    "HealthCollector._lock",
+    # leaf infrastructure: metrics registry and instruments, tracer, sinks
+    "MetricsRegistry._lock",
+    "SpanTracer._lock",
+    "JsonlFlusher._write_lock",
+    "TimeSeries._lock",
+    "Counter._lock",
+    "Gauge._lock",
+    "Histogram._lock",
+    "distributed._clock_lock",
+]
+
+#: Allow-listed acquisition edges ``(holder, acquired) -> reason``.
+#: Every entry documents WHY the edge cannot deadlock; the pass drops
+#: these edges before cycle/order checking.  No blanket suppressions —
+#: an empty reason string is rejected by the pass itself, and an entry
+#: must correspond to an edge the analyzer actually SEES (a dead entry
+#: would pre-suppress future genuine findings on that pair; see the
+#: coordinator note below for the one acquisition the AST cannot see).
+EXCEPTIONS: Dict[Tuple[str, str], str] = {}
+
+#: Documented-but-AST-invisible acquisition: ``SnapshotSetCoordinator.
+#: _cut`` holds EVERY shard hub's center lock at once via
+#: ``ExitStack.enter_context`` over a list of lock objects (an
+#: acquisition form the ``with``-scan cannot resolve, so it produces no
+#: graph edge and needs no EXCEPTIONS entry).  It cannot deadlock: the
+#: locks belong to DISTINCT hub instances, acquired in fixed hub-list
+#: order, and commit handlers take exactly one shard lock each — no
+#: cross-ordering exists to invert.  Recorded here so the design
+#: decision survives; if the cut is ever rewritten as literal nested
+#: ``with`` statements, the analyzer will see a
+#: (SocketParameterServer._lock, SocketParameterServer._lock) self-edge
+#: and THAT is the moment to allow-list it explicitly.
+
+#: Locks whose DECLARED PURPOSE is serializing blocking I/O on a shared
+#: resource -> reason.  The blocking-call-under-lock pass skips regions
+#: whose held locks all appear here; any other lock held concurrently
+#: still flags.  Point suppressions on individual lines use
+#: ``# lint: blocking-ok <reason>`` instead.
+IO_LOCKS: Dict[str, str] = {
+    "PSClient._io_lock": (
+        "the io lock IS the socket serializer: every request/reply pair, "
+        "heartbeat round trip and reconnect swap must run under it so the "
+        "pipelined FIFO can never interleave (the PR-7 fix bounded the "
+        "held-time with a short ping timeout rather than moving I/O out)"),
+    "JsonlFlusher._write_lock": (
+        "the write lock exists solely to keep concurrent JSONL appends "
+        "from tearing lines in the shared sink file"),
+}

@@ -226,7 +226,7 @@ class ReplicationFeed:
                         net.ACTION_REPL,
                         [net.encode_repl_header(clock, net.REPL_SYNC)]
                         + list(self.hub.center))
-                self._codec.send_packed(conn)
+                self._codec.send_packed(conn)  # lint: blocking-ok full-sync must serialize with the delta stream; stall bounded by REPLICA_SEND_TIMEOUT
             except BaseException:
                 self._conns.remove(entry)
                 raise
@@ -258,7 +258,7 @@ class ReplicationFeed:
                         + list(scaled))
                     packed = True
                 try:
-                    self._codec.send_packed(conn)
+                    self._codec.send_packed(conn)  # lint: blocking-ok send-before-ack IS the zero-loss replication contract; stall bounded by REPLICA_SEND_TIMEOUT, then detach
                 except (OSError, ValueError) as e:
                     dead.append((entry, e))
             for entry, e in dead:

@@ -785,122 +785,48 @@ def test_disabled_telemetry_hot_path_makes_zero_registry_calls(monkeypatch):
     assert len(obs.TRACER.events()) == 0
 
 
-def _ast_unused_imports(path):
-    """Minimal F401 stand-in for containers without ruff: imported names
-    never referenced in the module body (``__all__`` strings count, and a
-    ``# noqa`` on the import statement's first line is honored — the
-    re-export idiom runtime/__init__.py uses, which real ruff also
-    skips)."""
-    import ast
-
-    with open(path) as f:
-        source = f.read()
-    tree = ast.parse(source, filename=path)
-    lines = source.splitlines()
-    import re
-
-    # only a bare "# noqa" or one whose code list includes F401 suppresses
-    # the unused-import check — "# noqa: E501" does not, matching ruff
-    suppresses = re.compile(r"#\s*noqa(?!:)|#\s*noqa:[^#]*\bF401\b")
-    imported = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)) \
-                and suppresses.search(lines[node.lineno - 1]):
-            continue
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                imported[(a.asname or a.name).split(".")[0]] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue  # compiler directive, never "used"
-            for a in node.names:
-                if a.name != "*":
-                    imported[a.asname or a.name] = node.lineno
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.add(node.value)  # __all__ entries / docstring mentions
-    return {name: line for name, line in imported.items() if name not in used}
-
-
 @pytest.mark.parametrize("package", ["observability", "runtime", ".", "tests",
                                      "data", "parallel", "models", "ops",
-                                     "examples", "bench"])
+                                     "examples", "bench", "analysis"])
 def test_package_is_lint_clean(package):
-    """Satellite (PR 5, extended to runtime/ by PR 6, to the package's
-    top-level modules — checkpoint.py, utils.py, trainers.py, ... — by
-    PR 7, to ``tests/`` itself by PR 8, to the remaining packages —
-    data/, parallel/, models/, ops/ — by PR 9, and to the last
-    uncovered trees — both ``examples`` directories and the root-level
-    ``bench.py`` — by PR 10): ruff-clean check scoped to the
-    instrumented packages.  Runs real ruff when the container has it;
-    otherwise falls back to an AST unused-import (F401) sweep plus a
-    compile check.  ``"."`` scans the ``distkeras_tpu/*.py`` files
-    themselves (non-recursive; the subpackages have their own
-    parametrized cells); ``"tests"`` scans this directory;
-    ``"examples"`` scans ``distkeras_tpu/examples/`` AND the repo-root
-    ``examples/``; ``"bench"`` is the root ``bench.py`` file."""
+    """Satellite (PR 5, extended package-by-package through PR 10, and
+    consolidated by PR 12): ruff-clean check scoped to the instrumented
+    packages.  The implementation now lives in ONE place —
+    ``distkeras_tpu.analysis.unused_imports`` (real ruff when the
+    container has it, else an AST F401 sweep + compile check) — and
+    these named cells delegate, so there is one F401 implementation
+    instead of N copies while a scoping change can never silently drop
+    a package (the cell names are the coverage contract)."""
     import os
-    import py_compile
-    import shutil
-    import subprocess
+
+    from distkeras_tpu.analysis import unused_imports as ui
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if package == "tests":
-        files = [os.path.join(root, "tests", f)
-                 for f in sorted(os.listdir(os.path.join(root, "tests")))
-                 if f.endswith(".py")]
-    elif package == "bench":
-        files = [os.path.join(root, "bench.py")]
-    elif package == "examples":
-        files = []
-        for d in (os.path.join(root, "distkeras_tpu", "examples"),
-                  os.path.join(root, "examples")):
-            if os.path.isdir(d):
-                files.extend(os.path.join(d, f)
-                             for f in sorted(os.listdir(d))
-                             if f.endswith(".py"))
-    else:
-        pkg = os.path.normpath(os.path.join(root, "distkeras_tpu", package))
-        files = [os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
-                 if f.endswith(".py")]
-    ruff = shutil.which("ruff")
-    if ruff:
-        proc = subprocess.run([ruff, "check"] + files, capture_output=True,
-                              text=True, timeout=120)
-        assert proc.returncode == 0, proc.stdout + proc.stderr
-        return
-    for path in files:
-        py_compile.compile(path, doraise=True)
-        unused = _ast_unused_imports(path)
-        assert not unused, \
-            f"{os.path.basename(path)}: unused imports {unused}"
+    assert package in ui.PACKAGES, \
+        f"cell {package!r} dropped from analysis/unused_imports.PACKAGES"
+    assert ui.package_files(root, package), \
+        f"package {package!r} resolves to no files — coverage went hollow"
+    findings = ui.check_package(root, package)
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 @pytest.mark.parametrize("module", ["streaming.py", "job_deployment.py"])
 def test_runtime_stragglers_lint_clean_named(module):
-    """Satellite (PR 11): the last runtime modules named by the issue —
-    streaming.py and job_deployment.py — get their own NAMED lint cells
-    so a future scoping change to the package-level sweep can never
-    silently drop them (the package cell scans by listdir; this one pins
-    the two files by name)."""
+    """Satellite (PR 11, delegated to the one F401 implementation by
+    PR 12): the runtime modules named by ISSUE 11 — streaming.py and
+    job_deployment.py — keep their own NAMED lint cells so a future
+    scoping change to the package-level sweep can never silently drop
+    them (the package cell scans by listdir; this one pins the two
+    files by name)."""
     import os
-    import py_compile
+
+    from distkeras_tpu.analysis import unused_imports as ui
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "distkeras_tpu", "runtime", module)
     assert os.path.exists(path), f"{module} moved without updating the guard"
-    py_compile.compile(path, doraise=True)
-    unused = _ast_unused_imports(path)
-    assert not unused, f"{module}: unused imports {unused}"
+    findings = ui.check_files([path], root)
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 def test_telemetry_disabled_leaves_async_run_unrecorded(toy_dataset):
