@@ -1,7 +1,7 @@
 """``distkeras_tpu.analysis`` — the project-aware static-analysis suite
-behind ``distkeras-lint`` (ISSUE 12).
+behind ``distkeras-lint`` (ISSUE 12 + the ISSUE 14 concurrency layer).
 
-Four project-specific passes plus the consolidated F401 sweep:
+Seven project-specific passes plus the consolidated F401 sweep:
 
 - :mod:`~distkeras_tpu.analysis.lock_order` — lock-acquisition graph
   over ``runtime/`` + ``observability/`` checked against the declared
@@ -9,6 +9,15 @@ Four project-specific passes plus the consolidated F401 sweep:
 - :mod:`~distkeras_tpu.analysis.blocking` — blocking calls
   (``send*``/``recv*``/``time.sleep``/``Thread.join``/``subprocess``/
   ``.result()``) lexically inside held-lock regions;
+- :mod:`~distkeras_tpu.analysis.guarded_by` — which lock protects which
+  attribute: thread-root discovery, shared-state detection, and
+  held-region checking against ``lock_manifest.GUARDED_BY``;
+- :mod:`~distkeras_tpu.analysis.lockset` — Eraser-style DYNAMIC
+  validation of the same table under a stress harness (opt-in,
+  ``DKT_LOCKSET=1``);
+- :mod:`~distkeras_tpu.analysis.protocol_model` — the declared
+  client<->hub transition table cross-checked against the hub dispatch
+  plus bounded exhaustive interleaving/standby model checking;
 - :mod:`~distkeras_tpu.analysis.wire_parity` — ``ACTION_*`` registry vs
   the C++ hub's char-literal dispatch, plus NotImplementedError knob
   staleness;
@@ -19,8 +28,9 @@ Four project-specific passes plus the consolidated F401 sweep:
   implementation the per-package test cells delegate to.
 
 ``tests/test_analysis.py`` runs the full suite over the repo as a tier-1
-gate; the console script is ``distkeras-lint`` (see
-:mod:`~distkeras_tpu.analysis.cli`).
+gate (plus slow-marked lockset-stress and TSAN cells); the console
+script is ``distkeras-lint`` (see :mod:`~distkeras_tpu.analysis.cli`,
+including ``--baseline`` for incremental adoption).
 """
 
 from distkeras_tpu.analysis.core import Finding  # noqa: F401  (re-export)

@@ -24,9 +24,16 @@ import tokenize
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-#: rule ids, one per pass (the annotation grammar's ``<rule>`` vocabulary)
+#: rule ids (the annotation grammar's ``<rule>`` vocabulary).  Mostly one
+#: per pass; the guarded-by pass owns rule ``unguarded`` (the annotation
+#: reads ``# lint: unguarded-ok <reason>``) and ``protocol`` belongs to
+#: the model checker.  The DYNAMIC lockset pass is deliberately absent:
+#: its findings are runtime observations with no stable source anchor to
+#: annotate — fix the race or declare the attribute in GUARDED_BY — so a
+#: ``# lint: lockset-ok`` comment would be inert, and the hygiene sweep
+#: flags it as an unknown rule instead of letting it accumulate.
 RULES = ("lock-order", "blocking", "wire-parity", "telemetry",
-         "unused-import")
+         "unused-import", "unguarded", "protocol")
 
 #: anchored to the START of a comment token, so prose that merely
 #: mentions the grammar ("suppress with '# lint: ...'") never registers

@@ -23,7 +23,7 @@ that DEFINES the attribute, so subclass acquisitions unify), and
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 #: Outermost-first global acquisition order.  An observed edge A->B with
 #: both ends listed must satisfy index(A) < index(B).
@@ -84,6 +84,82 @@ EXCEPTIONS: Dict[Tuple[str, str], str] = {}
 #: ``with`` statements, the analyzer will see a
 #: (SocketParameterServer._lock, SocketParameterServer._lock) self-edge
 #: and THAT is the moment to allow-list it explicitly.
+
+#: Guarded-by manifest (ISSUE 14): ``ClassName._attr`` -> (guard, reason)
+#: for every attribute the guarded-by pass discovers as SHARED — written
+#: from more than one thread root (or from a multi-instance root such as
+#: the per-connection handler loop).  ``guard`` is a lock node name from
+#: the vocabulary above; every write to the attribute must then be
+#: inside that lock's held region (lexically or at method entry, see
+#: ``analysis/guarded_by.py``).  ``guard=None`` declares BY-DESIGN
+#: unguarded state and the reason is mandatory.  The table is
+#: self-cleaning: entries for attributes that are no longer shared,
+#: guards that name unknown locks, and reasonless ``None`` entries are
+#: all findings.  The dynamic lockset checker (``analysis/lockset.py``,
+#: ``DKT_LOCKSET=1``) validates the SAME table at runtime.
+GUARDED_BY: Dict[str, Tuple[Optional[str], str]] = {
+    # -- hub core state: everything the commit/pull/replication paths
+    #    read-modify-write lives under the center lock
+    "SocketParameterServer._clock": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer._clock_fence": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer.num_updates": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer._standby": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer.promoted": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer.promoted_at_clock":
+        ("SocketParameterServer._lock", ""),
+    # -- hub side-structures under their dedicated leaf locks
+    "SocketParameterServer._feed": ("SocketParameterServer._feed_lock", ""),
+    "SocketParameterServer._members":
+        ("SocketParameterServer._member_lock", ""),
+    "SocketParameterServer._member_seq":
+        ("SocketParameterServer._member_lock", ""),
+    "SocketParameterServer._retry_seq": ("SocketParameterServer._bp_lock", ""),
+    "SocketParameterServer._storm_until":
+        ("SocketParameterServer._bp_lock", ""),
+    "SocketParameterServer.backpressure_hints":
+        ("SocketParameterServer._bp_lock", ""),
+    # -- by-design unguarded hub state (reasons mandatory)
+    "SocketParameterServer._health": (None, (
+        "idempotent lazy bind of the process-wide health collector: every "
+        "racing handler stores the SAME singleton object, so the worst "
+        "outcome is a duplicate module attribute lookup")),
+    "SocketParameterServer._health_mod": (None, (
+        "idempotent lazy bind of the health module reference (same "
+        "singleton-bind argument as _health)")),
+    "SocketParameterServer._health_monitor": (None, (
+        "idempotent lazy bind of the process-wide monitor singleton; "
+        "readers null-check every use")),
+    # -- snapshot plane
+    "HubSnapshotter._next_step": ("HubSnapshotter._save_lock", ""),
+    "SnapshotSetCoordinator._next_step":
+        ("SnapshotSetCoordinator._save_lock", ""),
+    # -- adaptive plane
+    "AdaptiveRateController._scales": ("AdaptiveRateController._lock", ""),
+    # -- client pipeline state: the io lock serializes the FIFO and owns
+    #    the freshness clock the heartbeat reads
+    "PSClient._last_io": ("PSClient._io_lock", ""),
+    # -- codec tx buffer: single-owner per connection/direction BY
+    #    CONTRACT (class docstring); class-level analysis cannot see
+    #    instance confinement, so the contract is declared here instead
+    "FlatFrameCodec._tx": (None, (
+        "one codec per connection/direction owner by documented contract "
+        "— instances are thread-confined even though the CLASS is "
+        "reachable from many thread roots")),
+    # -- punchcard daemon
+    "Punchcard._jobs": ("Punchcard._lock", ""),
+    "Punchcard._lock_path": ("Punchcard._lock", ""),
+    "Punchcard._running": (None, (
+        "GIL-atomic run flag with one lifecycle transition each way; "
+        "accept/executor loops tolerate a stale read for one iteration "
+        "by design (stop() additionally severs the listener to wake them)")),
+    # -- native hub wrapper: same singleton-bind rule as the Python hub
+    "NativeParameterServer._health": (None, (
+        "idempotent lazy bind of the process-wide health collector "
+        "(poll thread and start() store the same singleton)")),
+    "NativeParameterServer._health_monitor": (None, (
+        "idempotent lazy bind of the process-wide monitor singleton; "
+        "readers null-check every use")),
+}
 
 #: Locks whose DECLARED PURPOSE is serializing blocking I/O on a shared
 #: resource -> reason.  The blocking-call-under-lock pass skips regions

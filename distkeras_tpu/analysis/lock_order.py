@@ -124,6 +124,18 @@ class ModuleIndex:
                     elif isinstance(node.value, ast.Name) \
                             and node.value.id in ann:
                         info.attr_class[t.attr] = ann[node.value.id]
+                    elif isinstance(node.value, ast.Call):
+                        # direct constructor assignment (``self._feed =
+                        # ReplicationFeed(self)``): the attribute's type
+                        # is the called class — resolved later against
+                        # the cross-module class index, so non-class
+                        # callees simply never resolve
+                        f = node.value.func
+                        cname = (f.id if isinstance(f, ast.Name)
+                                 else f.attr if isinstance(f, ast.Attribute)
+                                 else None)
+                        if cname is not None and cname[:1].isupper():
+                            info.attr_class.setdefault(t.attr, cname)
 
 
 class LockIndex:

@@ -1,0 +1,456 @@
+"""Wire-protocol model checker (pass 6 of ``distkeras-lint``) — ISSUE 14.
+
+The PS wire protocol is a set of client<->hub action sequences that PR
+12's parity pass only checks for *existence* (every byte handled
+somewhere).  This pass adds a declared **transition model** and checks
+it two ways:
+
+1. **Static cross-check** against the Python hub's dispatch
+   (``SocketParameterServer._handle_connection``):
+
+   - an action byte the hub *admits* (compares against ``action``) that
+     the model does not declare is *admitted-but-unmodeled* — the model
+     is the contract, so undeclared arms are protocol drift;
+   - a modeled request the hub does not admit is
+     *modeled-but-unhandled* — a client following the contract would
+     desync the stream;
+   - a modeled reply the handler provably never produces (neither the
+     ``ACTION_*`` constant nor its known encoder appears in the handler
+     body) is *modeled-but-unproduced*;
+   - model keys must be registered ``ACTION_*`` names (a typo'd key can
+     never match and would silently weaken the contract).
+
+2. **Bounded exhaustive exploration** of 2-client x hub interleavings
+   (:func:`explore_sessions`): every interleaving of every bounded
+   action script, with pipelining up to ``max_inflight``, checking
+
+   - **desync**: a reply kind that does not match the oldest
+     outstanding request's declared reply;
+   - **deadlock**: a reachable non-final state with no enabled event;
+
+   and of the standby/promotion state machine
+   (:func:`explore_standby`): sync-then-delta ``R`` feed, feed loss,
+   retry budget, commit-triggered promotion — checking that promotion
+   is **reachable**, that no commit is ever acked by an unpromoted
+   standby, and that the machine cannot deadlock.
+
+The model is data (:data:`REQUESTS`, :data:`STANDBY_RULES`) so fixture
+tests can seed violations; the shipped tables are the contract the real
+hubs are checked against.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from distkeras_tpu.analysis.core import (Finding, SourceFile,
+                                         apply_annotations, load_sources,
+                                         python_files, rel, repo_root)
+from distkeras_tpu.analysis.wire_parity import parse_action_registry
+
+SELF_PATH = "distkeras_tpu/analysis/protocol_model.py"
+
+#: The declared protocol: client-initiated action -> the reply kind the
+#: client must receive (None = no reply, connection closes).  ``R`` is
+#: the replica hello: the hub replies with an ``R`` sync frame and the
+#: connection leaves the request/reply regime (handoff to the feed).
+REQUESTS: Dict[str, Optional[str]] = {
+    "ACTION_TRACE": "ACTION_TRACE",
+    "ACTION_PULL": "ACTION_WEIGHTS",
+    "ACTION_SPARSE_PULL": "ACTION_SPARSE_WEIGHTS",
+    "ACTION_COMMIT": "ACTION_ACK",
+    "ACTION_QCOMMIT": "ACTION_ACK",
+    "ACTION_SPARSE_COMMIT": "ACTION_ACK",
+    "ACTION_SPARSE_QCOMMIT": "ACTION_ACK",
+    "ACTION_HEALTH": "ACTION_ACK",
+    "ACTION_PING": "ACTION_ACK",
+    "ACTION_RECONNECT": "ACTION_RETRY",
+    "ACTION_BYE": None,
+    "ACTION_REPL": "ACTION_REPL",
+}
+
+#: Actions that advance the hub's commit clock when served.
+CLOCK_BUMPERS: FrozenSet[str] = frozenset({
+    "ACTION_COMMIT", "ACTION_QCOMMIT",
+    "ACTION_SPARSE_COMMIT", "ACTION_SPARSE_QCOMMIT"})
+
+#: How the handler source proves it PRODUCES each reply kind: any of the
+#: listed tokens (an ``ACTION_*`` constant reference, an encoder helper,
+#: the feed class that owns the ``R`` stream) appearing in the handler
+#: body counts.
+REPLY_PRODUCERS: Dict[str, Tuple[str, ...]] = {
+    "ACTION_WEIGHTS": ("ACTION_WEIGHTS",),
+    "ACTION_ACK": ("ACTION_ACK",),
+    "ACTION_SPARSE_WEIGHTS": ("ACTION_SPARSE_WEIGHTS",),
+    "ACTION_TRACE": ("encode_time_payload",),
+    "ACTION_RETRY": ("encode_retry_payload",),
+    "ACTION_REPL": ("ReplicationFeed", "attach"),
+}
+
+#: The standby/promotion contract (ISSUE 7 semantics) as checkable
+#: flags — fixture tests flip these to seed violations.
+STANDBY_RULES: Dict[str, Any] = {
+    # a full R sync is what arms the standby with real job state
+    "sync_sets_synced": True,
+    # a commit landing while the feed is DOWN (primary presumed dead)
+    # promotes the standby before the commit is applied/acked
+    "commit_promotes": True,
+    # a commit while the feed is still UP is refused and severs the feed
+    # as a liveness probe (split-brain guard)
+    "commit_probe_severs": True,
+    # a never-synced standby must never promote (it holds seed weights)
+    "never_synced_promotes": False,
+    # feed-loss retries exhausted on a synced standby promote it
+    "loss_exhaustion_promotes": True,
+    # an ack may only leave a standby AFTER promotion
+    "ack_requires_promoted": True,
+}
+
+
+# -- static cross-check --------------------------------------------------------
+
+def _handler_fn(ps_src: SourceFile,
+                name: str = "_handle_connection") -> Optional[ast.FunctionDef]:
+    for node in ast.walk(ps_src.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def admitted_actions(ps_src: SourceFile) -> Dict[str, int]:
+    """``ACTION_*`` names the Python hub's dispatch compares the incoming
+    action byte against (``action == net.ACTION_X`` / ``action in
+    (...)``), with the comparison line."""
+    out: Dict[str, int] = {}
+    fn = _handler_fn(ps_src)
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        names = [n for n in ast.walk(node.left)
+                 if isinstance(n, ast.Name)]
+        if not any(n.id == "action" for n in names):
+            continue
+        for comp in node.comparators:
+            for sub in ast.walk(comp):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr.startswith("ACTION_"):
+                    out.setdefault(sub.attr, sub.lineno)
+                elif isinstance(sub, ast.Name) \
+                        and sub.id.startswith("ACTION_"):
+                    out.setdefault(sub.id, sub.lineno)
+    return out
+
+
+def handler_mentions(ps_src: SourceFile) -> Set[str]:
+    """Every name/attribute token in the handler body — the vocabulary
+    the reply-production check matches producers against."""
+    fn = _handler_fn(ps_src)
+    if fn is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def check_model_vs_dispatch(net_src: SourceFile, ps_src: SourceFile,
+                            root: str,
+                            requests: Optional[Dict[str, Optional[str]]]
+                            = None) -> List[Finding]:
+    requests = dict(REQUESTS if requests is None else requests)
+    findings: List[Finding] = []
+    registry = parse_action_registry(net_src)
+    admitted = admitted_actions(ps_src)
+    mentions = handler_mentions(ps_src)
+    ps_rel = rel(ps_src.path, root)
+    net_rel = rel(net_src.path, root)
+
+    for name in sorted(requests):
+        if name not in registry:
+            findings.append(Finding(
+                "protocol", SELF_PATH, 1,
+                f"model key {name} is not a registered ACTION_* in "
+                f"{net_rel} — a typo'd key never matches anything"))
+    for name, line in sorted(admitted.items()):
+        if name in registry and name not in requests:
+            findings.append(Finding(
+                "protocol", ps_rel, line,
+                f"{name} is admitted by the hub dispatch but not declared "
+                f"in protocol_model.REQUESTS — admitted-but-unmodeled "
+                f"protocol drift"))
+    for name in sorted(requests):
+        if name in registry and name not in admitted:
+            b, line = registry[name]
+            findings.append(Finding(
+                "protocol", net_rel, line,
+                f"{name} (byte '{b}') is modeled as a client request but "
+                f"the Python hub dispatch never admits it — "
+                f"modeled-but-unhandled"))
+    for name, reply in sorted(requests.items()):
+        if reply is None:
+            continue
+        producers = REPLY_PRODUCERS.get(reply, (reply,))
+        if not any(tok in mentions for tok in producers):
+            findings.append(Finding(
+                "protocol", ps_rel, 1,
+                f"model declares reply {reply} for {name} but the handler "
+                f"body references none of {sorted(producers)} — "
+                f"modeled-but-unproduced"))
+    modeled = set(requests) | {r for r in requests.values() if r}
+    for name, (b, line) in sorted(registry.items()):
+        if name not in modeled:
+            findings.append(Finding(
+                "protocol", net_rel, line,
+                f"registered action {name} (byte '{b}') appears nowhere in "
+                f"the protocol model — declare it as a request or reply in "
+                f"protocol_model.REQUESTS"))
+    return findings
+
+
+# -- bounded exhaustive exploration: 2 clients x hub ---------------------------
+
+#: the per-client action alphabet the session exploration draws scripts
+#: from — the request/reply core (T/G handshakes and the R handoff leave
+#: the regime and are covered by the standby model / static checks)
+SESSION_ALPHABET = ("ACTION_PULL", "ACTION_COMMIT", "ACTION_HEALTH",
+                    "ACTION_PING", "ACTION_BYE")
+
+
+def explore_sessions(requests: Optional[Dict[str, Optional[str]]] = None,
+                     hub_replies: Optional[Dict[str, Optional[str]]] = None,
+                     max_sends: int = 3, max_inflight: int = 2,
+                     clients: int = 2, clock_cap: int = 6,
+                     alphabet: Sequence[str] = SESSION_ALPHABET
+                     ) -> List[Finding]:
+    """Exhaustively interleave every bounded client script against the
+    hub.  ``requests`` is what CLIENTS expect (the model); ``hub_replies``
+    is what the hub produces (defaults to the same table — fixtures pass
+    a skewed or arm-missing table to seed desync/deadlock).
+
+    Client state: (sends left, expected-reply FIFO, closed).  Events:
+    a client sends any alphabet action (pipelined up to ``max_inflight``),
+    the hub serves a client's oldest queued request (atomic:
+    reply enqueued, clock bumped), a client consumes its oldest reply.
+    """
+    requests = dict(REQUESTS if requests is None else requests)
+    hub = dict(requests if hub_replies is None else hub_replies)
+    findings: List[Finding] = []
+
+    # state: (clock, per-client (sends_left, reqq, replyq, expq, closed))
+    init_client = (max_sends, (), (), (), False)
+    init = (0, tuple(init_client for _ in range(clients)))
+    seen = {init}
+    frontier: List[Tuple[Any, Tuple[str, ...]]] = [(init, ())]
+    while frontier:
+        (clock, cls), trace = frontier.pop()
+        moved = False
+        done = all(c[4] or (c[0] == 0 and not c[1] and not c[2] and not c[3])
+                   for c in cls)
+        for ci, (left, reqq, replyq, expq, closed) in enumerate(cls):
+            # client sends (branch over the whole alphabet)
+            if not closed and left > 0 and len(expq) < max_inflight:
+                for act in alphabet:
+                    if act not in requests:
+                        continue
+                    exp = requests[act]
+                    nc = (left - 1, reqq + (act,), replyq,
+                          expq + ((exp,) if exp is not None else ()),
+                          closed or act == "ACTION_BYE")
+                    _push(seen, frontier, clock, cls, ci, nc,
+                          trace + (f"c{ci} sends {act}",))
+                moved = True
+            # hub serves the oldest queued request
+            if reqq:
+                act = reqq[0]
+                if act in hub:
+                    reply = hub[act]
+                    nclock = min(clock_cap, clock + 1) \
+                        if act in CLOCK_BUMPERS else clock
+                    nc = (left, reqq[1:],
+                          replyq + ((reply,) if reply is not None else ()),
+                          expq, closed)
+                    _push(seen, frontier, nclock, cls, ci, nc,
+                          trace + (f"hub serves c{ci} {act}",))
+                    moved = True
+                # an arm the hub lacks: the request sits unserved forever
+                # (surfaces below as a deadlock when nothing else moves)
+            # client consumes the oldest reply
+            if replyq:
+                got = replyq[0]
+                if not expq:
+                    findings.append(_session_finding(
+                        f"client {ci} received {got} with no request "
+                        f"outstanding", trace))
+                    moved = True  # diagnosed, not deadlocked
+                    continue
+                want = expq[0]
+                if got != want:
+                    findings.append(_session_finding(
+                        f"desync: client {ci} expected {want} for its "
+                        f"oldest request but the hub produced {got}",
+                        trace + (f"c{ci} recv {got}",)))
+                    moved = True  # diagnosed, not deadlocked
+                    continue
+                nc = (left, reqq, replyq[1:], expq[1:], closed)
+                _push(seen, frontier, clock, cls, ci, nc,
+                      trace + (f"c{ci} recv {got}",))
+                moved = True
+        if not moved and not done:
+            findings.append(_session_finding(
+                "deadlock: no event enabled but clients still have "
+                "unserved requests or unmatched replies", trace))
+        if len(findings) >= 8:
+            break  # enough counterexamples; keep the report readable
+    return findings
+
+
+def _push(seen, frontier, clock, cls, ci, nc, trace) -> None:
+    state = (clock, cls[:ci] + (nc,) + cls[ci + 1:])
+    if state not in seen:
+        seen.add(state)
+        frontier.append((state, trace))
+
+
+def _session_finding(msg: str, trace: Tuple[str, ...]) -> Finding:
+    tail = " -> ".join(trace[-6:])
+    return Finding("protocol", SELF_PATH, 1,
+                   f"{msg} (trace: {tail})")
+
+
+# -- bounded exploration: standby / promotion ----------------------------------
+
+def explore_standby(rules: Optional[Dict[str, Any]] = None,
+                    retries: int = 2, max_commits: int = 3
+                    ) -> List[Finding]:
+    """Exhaustive walk of the standby lifecycle: R sync-then-delta feed,
+    feed loss + bounded retries, worker commits racing all of it.
+    Checks promotion reachability, the acked-while-standby invariant,
+    and deadlock freedom."""
+    rules = dict(STANDBY_RULES if rules is None else rules)
+    findings: List[Finding] = []
+    # state: (synced, feed_up, failures, promoted, commits_left)
+    init = (False, True, 0, False, max_commits)
+    seen = {init}
+    frontier: List[Tuple[Tuple, Tuple[str, ...]]] = [(init, ())]
+    promoted_reachable = False
+    while frontier:
+        state, trace = frontier.pop()
+        synced, feed_up, failures, promoted, commits_left = state
+        if promoted:
+            promoted_reachable = True
+        events: List[Tuple[str, Tuple, Optional[bool]]] = []
+        if feed_up and not promoted:
+            if rules["sync_sets_synced"]:
+                events.append(("feed_sync",
+                               (True, feed_up, 0, promoted, commits_left),
+                               None))
+            else:
+                events.append(("feed_sync", state, None))
+            if synced:
+                events.append(("feed_delta", state, None))
+            events.append(("feed_loss",
+                           (synced, False, failures, promoted, commits_left),
+                           None))
+        if not feed_up and not promoted:
+            if failures <= retries:
+                events.append(("feed_retry_fail",
+                               (synced, False, failures + 1, promoted,
+                                commits_left), None))
+            else:
+                promote = (synced and rules["loss_exhaustion_promotes"]) \
+                    or (not synced and rules["never_synced_promotes"])
+                if promote:
+                    events.append(("promote_on_loss",
+                                   (synced, False, failures, True,
+                                    commits_left), None))
+                else:
+                    # never-synced standby keeps retrying forever (capped
+                    # backoff) — model as a self-loop retry
+                    events.append(("feed_retry_fail", state, None))
+            events.append(("feed_reconnect",
+                           (synced, True, failures, promoted, commits_left),
+                           None))
+        if commits_left > 0:
+            if not synced and not promoted:
+                events.append(("commit_refused_unsynced", state, False))
+            elif promoted:
+                events.append(("commit_acked",
+                               (synced, feed_up, failures, promoted,
+                                commits_left - 1), True))
+            elif feed_up and rules["commit_probe_severs"]:
+                events.append(("commit_refused_probe",
+                               (synced, False, failures, promoted,
+                                commits_left), False))
+            elif rules["commit_promotes"]:
+                events.append(("commit_acked_after_promote",
+                               (synced, feed_up, failures, True,
+                                commits_left - 1), True))
+            else:
+                events.append(("commit_acked",
+                               (synced, feed_up, failures, promoted,
+                                commits_left - 1), True))
+        if promoted and commits_left == 0:
+            continue  # final: promoted, every commit served
+        if not events:
+            findings.append(Finding(
+                "protocol", SELF_PATH, 1,
+                f"standby deadlock: no event enabled in state "
+                f"synced={synced} feed_up={feed_up} promoted={promoted} "
+                f"(trace: {' -> '.join(trace[-6:])})"))
+            continue
+        for name, nstate, acked in events:
+            if acked and rules["ack_requires_promoted"] and not nstate[3]:
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"acked-commit-while-standby: event {name} acks a "
+                    f"commit but the hub is neither primary nor promoted "
+                    f"(trace: {' -> '.join(trace[-5:] + (name,))})"))
+                continue
+            if nstate not in seen:
+                seen.add(nstate)
+                frontier.append((nstate, trace + (name,)))
+        if len(findings) >= 8:
+            return findings
+    if not promoted_reachable:
+        findings.append(Finding(
+            "protocol", SELF_PATH, 1,
+            "unreachable-promotion: no interleaving of feed "
+            "sync/loss/retry and worker commits ever promotes the "
+            "standby — failover is impossible under these rules"))
+    return findings
+
+
+# -- the pass ------------------------------------------------------------------
+
+def check(net_src: SourceFile, ps_src: SourceFile, root: str,
+          sources: Optional[Dict[str, SourceFile]] = None) -> List[Finding]:
+    findings = check_model_vs_dispatch(net_src, ps_src, root)
+    # the exhaustive explorations are cheap (bounded, memoized) and run
+    # in the static gate — a model edit that desyncs or deadlocks fails
+    # the same run that introduced it
+    findings.extend(explore_sessions())
+    findings.extend(explore_standby())
+    return apply_annotations(findings, sources or {}, root, rule="protocol")
+
+
+def run(root: Optional[str] = None,
+        sources: Optional[Dict[str, SourceFile]] = None) -> List[Finding]:
+    root = root or repo_root()
+    net_path = os.path.join(root, "distkeras_tpu", "runtime", "networking.py")
+    ps_path = os.path.join(root, "distkeras_tpu", "runtime",
+                           "parameter_server.py")
+    if not (os.path.exists(net_path) and os.path.exists(ps_path)):
+        return []  # partial checkout; the repo gate runs on the real tree
+    if sources is None:
+        sources = load_sources(python_files(
+            root, (os.path.join("distkeras_tpu", "runtime"),)))
+    net_src = sources.get(net_path) or SourceFile(net_path)
+    ps_src = sources.get(ps_path) or SourceFile(ps_path)
+    return check(net_src, ps_src, root, sources)

@@ -106,7 +106,11 @@ class HubSnapshotter:
                               f"{type(e).__name__}: {e}")
                 continue
             self.hub.restore_state(trees["center"], meta)
-            self._next_step = max(self._next_step, step + 1)
+            # under the save lock: restore normally runs once at start,
+            # but it is public API — racing a live snapshot loop must
+            # not lose a step advance (guarded-by contract, ISSUE 14)
+            with self._save_lock:
+                self._next_step = max(self._next_step, step + 1)
             return True
         return False
 
@@ -3167,7 +3171,7 @@ class PSClient:
                 self._flip ^= 1
                 self._pending.appendleft((kind, t_sent))
                 raise
-            self._last_io = time.monotonic()
+            self._last_io = time.monotonic()  # lint: unguarded-ok receive leg runs outside the io lock by design; the _consuming flag excludes the heartbeat's round trips, and a racing timestamp store only under-reports idleness
             self._sparse_pull_ids.popleft()
             result: List[np.ndarray] = []
             si = 0
@@ -3189,7 +3193,7 @@ class PSClient:
             # the same ack byte; only the commit's round trip is a commit
             # latency sample
             reply = net.recv_action(self.sock)
-            self._last_io = time.monotonic()
+            self._last_io = time.monotonic()  # lint: unguarded-ok receive leg runs outside the io lock by design; the _consuming flag excludes the heartbeat's round trips, and a racing timestamp store only under-reports idleness
             if reply != net.ACTION_ACK:
                 raise ConnectionError(f"expected ack, got {reply!r}")
             if kind == net.ACTION_ACK and obs.enabled():
@@ -3212,7 +3216,7 @@ class PSClient:
                 self._flip ^= 1
                 self._pending.appendleft((kind, t_sent))
                 raise
-            self._last_io = time.monotonic()
+            self._last_io = time.monotonic()  # lint: unguarded-ok receive leg runs outside the io lock by design; the _consuming flag excludes the heartbeat's round trips, and a racing timestamp store only under-reports idleness
             # a full pull re-seeds the sparse caches: the landing buffer
             # is reused two pulls later, the cache is the stable copy the
             # sparse exchange merges into
@@ -3798,7 +3802,11 @@ class SnapshotSetCoordinator:
                 continue
             for hub, tree, m in zip(self.hubs, trees, metas):
                 hub.restore_state(tree["center"], m)
-            self._next_step = max(self._next_step, step + 1)
+            # under the save lock: same contract as HubSnapshotter —
+            # a restore racing the periodic save loop must not lose a
+            # step advance (guarded-by contract, ISSUE 14)
+            with self._save_lock:
+                self._next_step = max(self._next_step, step + 1)
             return True
         raise RuntimeError(
             f"restore requested: snapshot sets exist under {self.directory} "

@@ -90,7 +90,6 @@
 
 #include <algorithm>
 #include <atomic>
-#include <condition_variable>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -457,23 +456,27 @@ class ParameterServer {
 
   // returns the bound port, or -1 on failure
   int start() {
-    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (listen_fd_ < 0) return -1;
+    // bind on a local fd, publish into the atomic only once listening:
+    // stop() (another thread) shuts the published fd down to wake the
+    // accept loop, so the handoff itself must be race-free (TSAN-pinned
+    // by the ISSUE-14 stress cell)
+    int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) return -1;
     int one = 1;
-    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_addr.s_addr = htonl(INADDR_ANY);
     addr.sin_port = htons(uint16_t(requested_port_));
-    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
-        ::listen(listen_fd_, 128) != 0) {
-      ::close(listen_fd_);
-      listen_fd_ = -1;
+    if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(lfd, 128) != 0) {
+      ::close(lfd);
       return -1;
     }
     socklen_t len = sizeof(addr);
-    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &len);
     bound_port_ = ntohs(addr.sin_port);
+    listen_fd_.store(lfd);
     running_.store(true);
     accept_thread_ = std::thread([this] { accept_loop(); });
     if (replica_port_ >= 0) {
@@ -484,27 +487,34 @@ class ParameterServer {
   }
 
   void stop() {
+    // one mutex serializes the WHOLE teardown: concurrent stop() calls
+    // (user stop racing a destructor) must not both reach the thread
+    // joins — joining the same std::thread twice is UB
+    std::lock_guard<std::mutex> stop_guard(stop_mtx_);
     bool was_running = running_.exchange(false);
-    if (!was_running && listen_fd_ < 0 && !replica_thread_.joinable()) return;
+    if (!was_running && listen_fd_.load() < 0 && !replica_thread_.joinable())
+      return;
     replica_stop_.store(true);
-    {
-      std::lock_guard<std::mutex> g(sync_mtx_);
-      stopped_ = true;
-    }
-    sync_cv_.notify_all();
+    stopped_.store(true);
     int rfd = replica_fd_.load();
     if (rfd >= 0) ::shutdown(rfd, SHUT_RDWR);
-    if (listen_fd_ >= 0) {
-      ::shutdown(listen_fd_, SHUT_RDWR);
-      ::close(listen_fd_);
-      listen_fd_ = -1;
-    }
+    // shutdown ONLY here — shutdown() wakes the blocked accept()
+    // (EINVAL) but keeps the descriptor NUMBER reserved, so no accept()
+    // call (nor this shutdown) can ever hit a kernel-reused fd.  The
+    // close happens below, AFTER the accept thread is joined — the only
+    // point where provably nothing references the descriptor.
+    int lfd = listen_fd_.load();
+    if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
     {
       std::lock_guard<std::mutex> g(conn_mutex_);
       for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
     }
     if (feed_) feed_->close_all();
     if (accept_thread_.joinable()) accept_thread_.join();
+    // exchange claims the close exactly once, after the join: the
+    // accept loop has exited, so the fd is provably unreferenced
+    lfd = listen_fd_.exchange(-1);
+    if (lfd >= 0) ::close(lfd);
     if (replica_thread_.joinable()) replica_thread_.join();
     for (auto& t : handler_threads_)
       if (t.joinable()) t.join();
@@ -546,12 +556,18 @@ class ParameterServer {
   }
 
   bool wait_synced(int64_t timeout_ms) {
-    std::unique_lock<std::mutex> g(sync_mtx_);
-    if (timeout_ms < 0) {
-      sync_cv_.wait(g, [&] { return synced_.load() || stopped_; });
-    } else {
-      sync_cv_.wait_for(g, std::chrono::milliseconds(timeout_ms),
-                        [&] { return synced_.load() || stopped_; });
+    // bounded poll on the sync/stop atomics.  This was a condvar, but
+    // libstdc++'s wait_for lowers to pthread_cond_clockwait, which
+    // gcc-10-era libtsan does not intercept — every TSAN run read the
+    // wakeup as a phantom double-lock.  wait_synced is a once-per-attach
+    // latency path, so millisecond polling granularity costs nothing
+    // and keeps the hub condvar-free.
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    while (!synced_.load() && !stopped_.load()) {
+      if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
+        break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
     }
     return synced_.load();
   }
@@ -1225,11 +1241,7 @@ class ParameterServer {
               if (!ok) break;
               clock_ = fclock;
               num_updates_.store(fclock);
-              if (!synced_.load()) {
-                std::lock_guard<std::mutex> sg(sync_mtx_);
-                synced_.store(true);
-              }
-              sync_cv_.notify_all();
+              synced_.store(true);
             } else if (kind == kReplDelta) {
               float* c = center_.data();
               for (size_t i = 0; i < sizes_.size(); ++i) {
@@ -1302,8 +1314,8 @@ class ParameterServer {
   // -- serving loop -----------------------------------------------------------
   void accept_loop() {
     while (running_.load()) {
-      int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) break;  // listener closed by stop()
+      int fd = ::accept(listen_fd_.load(), nullptr, nullptr);
+      if (fd < 0) break;  // listener shut down by stop()
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       // kernel buffers sized to one full weights/commit frame (clamped to
@@ -1324,6 +1336,9 @@ class ParameterServer {
       conn_fds_.push_back(fd);
       handler_threads_.emplace_back([this, fd] { handle_connection(fd); });
     }
+    // NO close here: a spontaneous accept() failure (EMFILE storm)
+    // exits this loop while stop() may still be about to shutdown the
+    // fd it loaded — stop() owns the close, after joining this thread
   }
 
   // -- payload parsing --------------------------------------------------------
@@ -1857,14 +1872,13 @@ class ParameterServer {
   std::atomic<bool> standby_{false};
   std::atomic<bool> promoted_flag_{false};
   std::atomic<bool> synced_{false};
-  std::mutex sync_mtx_;
-  std::condition_variable sync_cv_;
-  bool stopped_ = false;
+  std::atomic<bool> stopped_{false};
+  std::mutex stop_mtx_;  // serializes concurrent stop() teardowns (join is UB twice)
   std::thread replica_thread_;
 
   // -- serving ----------------------------------------------------------------
   std::atomic<bool> running_{false};
-  int listen_fd_ = -1;
+  std::atomic<int> listen_fd_{-1};
   std::thread accept_thread_;
   std::mutex conn_mutex_;
   std::vector<int> conn_fds_;

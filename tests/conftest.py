@@ -36,6 +36,21 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             f"suite before it flakes (ROADMAP operational warning, PR 10).",
             yellow=True)
 
+def require_tool(*names):
+    """Shared skip-guard for cells that shell out to optional toolchain
+    binaries (g++, cppcheck, clang-tidy, ...): skip — not fail — in
+    containers that don't ship them.  One helper so the
+    cppcheck/clang-tidy, -Wall/-Wextra/-Werror and TSAN cells can never
+    drift on how 'tool missing' is decided (ISSUE 14 satellite)."""
+    import shutil
+
+    import pytest as _pytest
+
+    for name in names:
+        if shutil.which(name) is None:
+            _pytest.skip(f"no {name} in this container")
+
+
 from distkeras_tpu.platform import pin_cpu_devices  # noqa: E402
 
 pin_cpu_devices(8)

@@ -1,29 +1,36 @@
-"""``distkeras-lint`` — the project-aware static-analysis suite (ISSUE 12).
+"""``distkeras-lint`` — the project-aware static-analysis suite
+(ISSUE 12 + the ISSUE 14 concurrency-contract layer).
 
-Two layers:
+Three layers:
 
 - the **tier-1 gate**: the full suite runs over THIS repo on every test
   run and must come back clean in under 10 seconds — lock-order,
-  blocking-under-lock, wire-action parity, telemetry registry, unused
-  imports;
+  blocking-under-lock, guarded-by, wire-action parity, protocol model,
+  telemetry registry, unused imports;
 - **fixture tests**: each analyzer is proven against synthetic known-bad
-  snippets (a seeded lock cycle, the PR-8 ``monitor()`` deadlock shape, a
-  misspelled ``ps_comit_bytes_total`` metric, a C++ hub missing a
-  dispatch arm) and the suppression mechanisms are proven to suppress
-  exactly the annotated line / allow-listed edge, never more.
+  snippets (a seeded lock cycle, the PR-8 ``monitor()`` deadlock shape,
+  an unguarded shared write, a lockset intersection going empty, a
+  missing/extra protocol arm, a desyncing reply table, a misspelled
+  ``ps_comit_bytes_total`` metric, a C++ hub missing a dispatch arm) and
+  the suppression mechanisms are proven to suppress exactly the
+  annotated line / allow-listed edge / declared attribute, never more;
+- **dynamic cells** (slow-marked): the ``DKT_LOCKSET`` lockset stress
+  harness and the ``-fsanitize=thread`` native hub stress, both of
+  which must come back report-free at HEAD.
 """
 
 import os
-import shutil
 import subprocess
 import time
 
 import pytest
 
-from distkeras_tpu.analysis import blocking, cli, lock_order, telemetry
+from distkeras_tpu.analysis import (blocking, cli, guarded_by, lock_manifest,
+                                    lock_order, lockset, protocol_model,
+                                    telemetry)
 from distkeras_tpu.analysis import unused_imports as ui
 from distkeras_tpu.analysis import wire_parity
-from distkeras_tpu.analysis.core import SourceFile, repo_root
+from distkeras_tpu.analysis.core import Finding, SourceFile, repo_root
 from distkeras_tpu.analysis.telemetry_registry import TELEMETRY_NAMES
 
 ROOT = repo_root()
@@ -745,10 +752,11 @@ def test_unused_import_packages_cover_the_historical_cells():
 ])
 def test_native_cpp_static_analysis(tool, args):
     """CI/tooling satellite: run clang-tidy/cppcheck over ``native/*.cpp``
-    when the container ships them (skip-guarded, mirroring the
-    ``-Wall -Wextra -Werror`` build-hygiene test)."""
-    if shutil.which(tool) is None:
-        pytest.skip(f"no {tool} in this container")
+    when the container ships them (skip-guarded via the shared
+    ``require_tool`` helper, like the ``-Werror`` and TSAN cells)."""
+    from conftest import require_tool
+
+    require_tool(tool)
     srcs = sorted(
         os.path.join(ROOT, "native", f)
         for f in os.listdir(os.path.join(ROOT, "native"))
@@ -760,3 +768,678 @@ def test_native_cpp_static_analysis(tool, args):
         cmd = [tool] + args + srcs
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- guarded-by fixtures (ISSUE 14 tentpole) -----------------------------------
+
+_SHARED_FIXTURE = """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self._count += 1
+
+    def bump(self):
+        self._count += 1
+"""
+
+
+def test_guarded_by_detects_undeclared_shared_write(tmp_path):
+    """An attribute written from a thread root AND the caller's thread
+    with no GUARDED_BY entry flags at every write site (outside
+    ``__init__``)."""
+    sources = _src(tmp_path, "hub.py", _SHARED_FIXTURE)
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by={})
+    lines = sorted(f.line for f in findings)
+    assert lines == [13, 16], [str(f) for f in findings]
+    assert all("no GUARDED_BY entry" in f.message for f in findings)
+    assert any("Hub._loop" in f.message for f in findings)
+
+
+def test_guarded_by_declared_guard_checks_held_region(tmp_path):
+    sources = _src(tmp_path, "hub.py", """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._count += 1
+
+    def bump(self):
+        self._count += 1
+""")
+    table = {"Hub._count": ("Hub._lock", "")}
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by=table)
+    assert [f.line for f in findings] == [17], [str(f) for f in findings]
+    assert "outside its held region" in findings[0].message
+    assert "Hub._lock" in findings[0].message
+
+
+def test_guarded_by_annotation_suppresses_exactly_one_line(tmp_path):
+    sources = _src(tmp_path, "hub.py", """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self._count += 1  # lint: unguarded-ok fixture: loop owns it pre-promotion
+            self._count += 2
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+""")
+    table = {"Hub._count": ("Hub._lock", "")}
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by=table)
+    assert [f.line for f in findings] == [14], [str(f) for f in findings]
+
+
+def test_guarded_by_entry_held_inference_covers_locked_helpers(tmp_path):
+    """The ``*_locked`` convention, checked instead of trusted: a helper
+    whose EVERY resolved call site holds the guard is lock-held at
+    entry, so its writes are clean — and a second caller without the
+    lock breaks the inference."""
+    clean = """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clock = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self.commit()
+
+    def commit(self):
+        with self._lock:
+            self._apply_locked()
+
+    def _apply_locked(self):
+        self._clock += 1
+"""
+    table = {"Hub._clock": ("Hub._lock", "")}
+    sources = _src(tmp_path, "hub.py", clean)
+    assert not guarded_by.check(sources, str(tmp_path), guarded_by=table)
+    broken = clean + """\
+
+    def sneak(self):
+        self._apply_locked()
+"""
+    sources = _src(tmp_path, "hub2.py", broken)
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by=table)
+    assert [f.line for f in findings] == [20], [str(f) for f in findings]
+
+
+def test_guarded_by_multi_root_handler_loop_is_shared(tmp_path):
+    """A root spawned in a loop (one handler thread per connection)
+    races ITSELF — attributes it writes are shared even with no other
+    writer."""
+    sources = _src(tmp_path, "hub.py", """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._served = 0
+
+    def _accept_loop(self):
+        while True:
+            threading.Thread(target=self._handle, daemon=True).start()
+
+    def start(self):
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _handle(self):
+        self._served += 1
+""")
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by={})
+    assert [f.line for f in findings] == [16], [str(f) for f in findings]
+
+
+def test_guarded_by_element_store_counts_and_init_exempt(tmp_path):
+    sources = _src(tmp_path, "hub.py", """\
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._center = [0, 0]
+        self._center[0] = 1  # __init__ writes are exempt
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self._center[0] += 1
+
+    def reset(self):
+        self._center[1] = 0
+""")
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by={})
+    assert sorted(f.line for f in findings) == [14, 17], \
+        [str(f) for f in findings]
+
+
+def test_guarded_by_manifest_is_self_cleaning(tmp_path):
+    """Stale entries, unknown guards, and reasonless None guards are
+    findings; a reasoned None entry suppresses whole-attribute."""
+    sources = _src(tmp_path, "hub.py", _SHARED_FIXTURE)
+    # stale: attr not shared anywhere
+    findings = guarded_by.check(
+        sources, str(tmp_path),
+        guarded_by={"Hub._gone": ("Hub._lock", ""),
+                    "Hub._count": ("Hub._lock", "")})
+    msgs = [f.message for f in findings]
+    assert any("stale GUARDED_BY entry" in m and "Hub._gone" in m
+               for m in msgs), msgs
+    # unknown guard lock node
+    findings = guarded_by.check(
+        sources, str(tmp_path),
+        guarded_by={"Hub._count": ("Hub._mystery_lock", "")})
+    assert any("not a known lock node" in f.message for f in findings)
+    # None guard requires a reason...
+    findings = guarded_by.check(
+        sources, str(tmp_path), guarded_by={"Hub._count": (None, " ")})
+    assert any("no reason" in f.message for f in findings)
+    # ...and with one, the attribute is by-design unguarded: clean
+    assert not guarded_by.check(
+        sources, str(tmp_path),
+        guarded_by={"Hub._count": (None, "fixture: monotonic hint only")})
+
+
+def test_guarded_by_subscribe_callback_is_a_root(tmp_path):
+    sources = _src(tmp_path, "hub.py", """\
+import threading
+
+class Hub:
+    def __init__(self, monitor):
+        self._lock = threading.Lock()
+        self._scale = 1.0
+        self.monitor = monitor
+
+    def start(self):
+        self.monitor.subscribe(self._on_event)
+
+    def _on_event(self, event):
+        self._scale = 0.5
+
+    def reset(self):
+        self._scale = 1.0
+""")
+    findings = guarded_by.check(sources, str(tmp_path), guarded_by={})
+    assert sorted(f.line for f in findings) == [13, 16], \
+        [str(f) for f in findings]
+    assert any("Hub._on_event" in f.message for f in findings)
+
+
+def test_guarded_by_real_tree_discovery_pins():
+    """Meta-regression: the pass only means something while it can SEE
+    the hub's real thread roots and shared state.  Pin the handler loop
+    as a multi root, the clock under the center lock, and the
+    by-design ``_consume_one_inner`` annotations."""
+    from distkeras_tpu.analysis.core import load_sources, python_files
+
+    sources = load_sources(python_files(ROOT, lock_order.DEFAULT_SUBDIRS))
+    gb = guarded_by.GuardedByIndex(sources, ROOT)
+    assert gb.roots.get("SocketParameterServer._handle_connection") is True
+    assert "SocketParameterServer._replica_loop" in gb.roots
+    assert "PSClient._heartbeat_loop" in gb.roots
+    shared = gb.shared_attrs(gb.contexts())
+    assert "SocketParameterServer._clock" in shared
+    assert lock_manifest.GUARDED_BY["SocketParameterServer._clock"][0] == \
+        "SocketParameterServer._lock"
+    # the three receive-leg timestamp stores stay annotated WITH reasons
+    ps = SourceFile(os.path.join(ROOT, "distkeras_tpu", "runtime",
+                                 "parameter_server.py"))
+    anns = [(ln, reason) for ln, (rule, reason) in ps.annotations.items()
+            if rule == "unguarded"]
+    assert len(anns) >= 3, anns
+    assert all(reason.strip() for _, reason in anns), anns
+
+
+# -- protocol-model fixtures ---------------------------------------------------
+
+_PM_NET = """\
+ACTION_PULL = b"P"
+ACTION_WEIGHTS = b"W"
+ACTION_ZAP = b"Z"
+"""
+
+_PM_PS = """\
+class Hub:
+    def _handle_connection(self, conn):
+        action = self._read(conn)
+        if action == net.ACTION_PULL:
+            reply.pack(net.ACTION_WEIGHTS)
+"""
+
+
+def test_protocol_modeled_but_unhandled_arm(tmp_path):
+    net_src = SourceFile(str(tmp_path / "networking.py"), _PM_NET)
+    ps_src = SourceFile(str(tmp_path / "parameter_server.py"), _PM_PS)
+    findings = protocol_model.check_model_vs_dispatch(
+        net_src, ps_src, str(tmp_path),
+        requests={"ACTION_PULL": "ACTION_WEIGHTS", "ACTION_ZAP": None})
+    assert any("modeled-but-unhandled" in f.message and "ACTION_ZAP"
+               in f.message for f in findings), [f.message for f in findings]
+
+
+def test_protocol_admitted_but_unmodeled_arm(tmp_path):
+    net_src = SourceFile(str(tmp_path / "networking.py"), _PM_NET)
+    ps_src = SourceFile(str(tmp_path / "parameter_server.py"), """\
+class Hub:
+    def _handle_connection(self, conn):
+        action = self._read(conn)
+        if action == net.ACTION_PULL:
+            reply.pack(net.ACTION_WEIGHTS)
+        elif action == net.ACTION_ZAP:
+            pass
+""")
+    findings = protocol_model.check_model_vs_dispatch(
+        net_src, ps_src, str(tmp_path),
+        requests={"ACTION_PULL": "ACTION_WEIGHTS"})
+    assert any("admitted-but-unmodeled" in f.message and "ACTION_ZAP"
+               in f.message for f in findings), [f.message for f in findings]
+
+
+def test_protocol_modeled_but_unproduced_reply(tmp_path):
+    net_src = SourceFile(str(tmp_path / "networking.py"), _PM_NET)
+    ps_src = SourceFile(str(tmp_path / "parameter_server.py"), """\
+class Hub:
+    def _handle_connection(self, conn):
+        action = self._read(conn)
+        if action == net.ACTION_PULL:
+            pass
+""")
+    findings = protocol_model.check_model_vs_dispatch(
+        net_src, ps_src, str(tmp_path),
+        requests={"ACTION_PULL": "ACTION_WEIGHTS"})
+    assert any("modeled-but-unproduced" in f.message for f in findings), \
+        [f.message for f in findings]
+
+
+def test_protocol_session_exploration_finds_desync_and_deadlock():
+    """Bounded exhaustive 2-client interleavings: a hub replying the
+    wrong kind desyncs; a hub missing an arm deadlocks; the shipped
+    table does neither."""
+    assert not protocol_model.explore_sessions()
+    skew = dict(protocol_model.REQUESTS)
+    skew["ACTION_PULL"] = "ACTION_ACK"
+    findings = protocol_model.explore_sessions(hub_replies=skew)
+    assert findings and all("desync" in f.message for f in findings)
+    missing = dict(protocol_model.REQUESTS)
+    del missing["ACTION_COMMIT"]
+    findings = protocol_model.explore_sessions(hub_replies=missing)
+    assert any("deadlock" in f.message for f in findings)
+
+
+def test_protocol_standby_model_checks_promotion():
+    """The standby machine: shipped rules promote and never ack while
+    standby; breaking commit-promotion produces acked-while-standby,
+    and breaking every promotion path makes promotion unreachable."""
+    assert not protocol_model.explore_standby()
+    rules = dict(protocol_model.STANDBY_RULES)
+    rules["commit_promotes"] = False
+    findings = protocol_model.explore_standby(rules=rules)
+    assert any("acked-commit-while-standby" in f.message for f in findings)
+    rules["loss_exhaustion_promotes"] = False
+    findings = protocol_model.explore_standby(rules=rules)
+    assert any("unreachable-promotion" in f.message for f in findings)
+
+
+def test_protocol_model_covers_full_registry():
+    """Every registered ACTION_* byte is either a modeled request or a
+    modeled reply — a 17th action must extend the model in the same PR
+    that registers it."""
+    net_src = SourceFile(os.path.join(ROOT, "distkeras_tpu", "runtime",
+                                      "networking.py"))
+    registry = wire_parity.parse_action_registry(net_src)
+    modeled = set(protocol_model.REQUESTS) | {
+        r for r in protocol_model.REQUESTS.values() if r}
+    assert set(registry) == modeled, sorted(
+        set(registry) ^ modeled)
+
+
+# -- lockset (dynamic) fixtures ------------------------------------------------
+
+def test_lockset_declared_guard_violation_detected():
+    import threading
+
+    class Victim:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+
+        def bump_racy(self):
+            self._count += 1
+
+    with lockset.instrument(
+            Victim,
+            guarded_by={"Victim._count": ("Victim._lock", "")}) as chk:
+        v = Victim()
+        ts = [threading.Thread(
+            target=lambda: [v.bump_racy() for _ in range(50)])
+            for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert any("declared guarded by Victim._lock" in f.message
+               for f in chk.findings), [str(f) for f in chk.findings]
+    assert all(f.rule == "lockset" for f in chk.findings)
+
+
+def test_lockset_empty_intersection_on_undeclared_attr():
+    import threading
+
+    class Victim:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+            self._x = 0
+
+        def a(self):
+            with self._l1:
+                self._x += 1
+
+        def b(self):
+            with self._l2:
+                self._x += 1
+
+    with lockset.instrument(Victim) as chk:
+        v = Victim()
+        ts = [threading.Thread(target=lambda fn=fn: [fn() for _ in range(50)])
+              for fn in (v.a, v.b, v.a)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert any("lockset went EMPTY" in f.message for f in chk.findings), \
+        [str(f) for f in chk.findings]
+
+
+def test_lockset_consistent_locking_and_handoff_are_clean():
+    """One consistent guard never flags; init-then-handoff to a single
+    other thread (daemon-loop state) never flags either — the classic
+    Eraser false positive the two-writer refinement removes."""
+    import threading
+
+    class Clean:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._owned = 0  # written only by the loop thread after init
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def loop(self):
+            for _ in range(100):
+                self._owned += 1
+
+    with lockset.instrument(Clean) as chk:
+        c = Clean()
+        ts = [threading.Thread(target=lambda: [c.bump() for _ in range(50)])
+              for _ in range(2)]
+        ts.append(threading.Thread(target=c.loop))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert not chk.findings, [str(f) for f in chk.findings]
+    assert chk.writes_checked > 0
+
+
+def test_lockset_instrument_restores_classes():
+    import threading
+
+    class Plain:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._x = 0
+
+    before_setattr = Plain.__dict__.get("__setattr__")
+    before_init = Plain.__init__
+    with lockset.instrument(Plain):
+        p = Plain()
+        assert isinstance(p._lock, lockset.TrackingLock)
+    assert Plain.__dict__.get("__setattr__") is before_setattr
+    assert Plain.__init__ is before_init
+    assert isinstance(Plain()._lock, type(threading.Lock()))
+
+
+def test_lockset_run_is_inert_without_env(monkeypatch):
+    monkeypatch.delenv("DKT_LOCKSET", raising=False)
+    assert lockset.run(ROOT) == []
+    assert not lockset.enabled()
+    monkeypatch.setenv("DKT_LOCKSET", "1")
+    assert lockset.enabled()
+
+
+@pytest.mark.slow
+def test_lockset_stress_harness_is_clean():
+    """The DKT_LOCKSET gate: hammer commit/pull/sparse/replication/health
+    concurrently under instrumentation — zero dynamic findings at HEAD
+    (the guarded-by table holds at runtime, not just lexically)."""
+    findings = lockset.stress(duration=2.0)
+    assert not findings, [str(f) for f in findings]
+
+
+# -- baseline mode (incremental adoption) --------------------------------------
+
+def _fake_results():
+    return {"guarded-by": [
+        Finding("unguarded", "pkg/a.py", 10, "A is unguarded"),
+        Finding("unguarded", "pkg/b.py", 20, "B is unguarded"),
+    ]}
+
+
+def test_baseline_write_compare_and_burn_down(tmp_path):
+    base = tmp_path / "lint-baseline.json"
+    n = cli.write_baseline(str(base), _fake_results())
+    assert n == 2
+    loaded = cli.load_baseline(str(base))
+    # identical findings: all suppressed, nothing stale, nothing new
+    kept, suppressed, stale = cli.apply_baseline(_fake_results(), loaded)
+    assert suppressed == 2 and not stale
+    assert not any(kept.values())
+    # one fixed, one new: the fixed entry reports stale, the new fails
+    now = {"guarded-by": [
+        Finding("unguarded", "pkg/b.py", 21, "B is unguarded"),  # line moved
+        Finding("unguarded", "pkg/c.py", 5, "C is unguarded"),   # new
+    ]}
+    kept, suppressed, stale = cli.apply_baseline(now, loaded)
+    assert suppressed == 1  # B matches despite the line shift
+    assert [s[1] for s in stale] == ["pkg/a.py"]
+    assert [f.path for f in kept["guarded-by"]] == ["pkg/c.py"]
+
+
+def test_baseline_is_multiplicity_aware_and_pass_subset_safe(tmp_path):
+    """A baseline with ONE entry suppresses at most one identical
+    finding — a second same-message violation (a new unguarded write of
+    the same attribute) still fails — and a --pass subset run must not
+    report other passes' entries as stale."""
+    base = tmp_path / "base.json"
+    cli.write_baseline(str(base), _fake_results())
+    loaded = cli.load_baseline(str(base))
+    doubled = {"guarded-by": [
+        Finding("unguarded", "pkg/a.py", 10, "A is unguarded"),
+        Finding("unguarded", "pkg/a.py", 30, "A is unguarded"),  # NEW site
+        Finding("unguarded", "pkg/b.py", 20, "B is unguarded"),
+    ]}
+    kept, suppressed, stale = cli.apply_baseline(doubled, loaded)
+    assert suppressed == 2 and not stale
+    assert [f.line for f in kept["guarded-by"]] == [30]
+    # subset run: only the lock-order pass executed, so the guarded-by
+    # entries are NOT stale (their pass never looked)
+    kept, suppressed, stale = cli.apply_baseline({"lock-order": []}, loaded)
+    assert suppressed == 0 and not stale
+
+
+def test_baseline_inert_lockset_entries_never_read_stale(tmp_path,
+                                                         monkeypatch):
+    """A lockset baseline entry (recorded under DKT_LOCKSET=1) must not
+    be reported stale by a plain run, where the lockset pass 'ran' but
+    checked nothing — and must be once the checker is live again."""
+    loaded = [("lockset", "pkg/hub.py", "X raced")]
+    monkeypatch.delenv("DKT_LOCKSET", raising=False)
+    _kept, _sup, stale = cli.apply_baseline({"lockset": []}, loaded)
+    assert not stale
+    monkeypatch.setenv("DKT_LOCKSET", "1")
+    _kept, _sup, stale = cli.apply_baseline({"lockset": []}, loaded)
+    assert stale == loaded
+
+
+def test_stray_lockset_annotation_is_flagged_as_unknown_rule(tmp_path):
+    """The dynamic lockset pass deliberately has NO annotation rule —
+    a '# lint: lockset-ok' comment is inert, so the hygiene sweep must
+    report it instead of letting it accumulate."""
+    sources = _src(tmp_path, "mod.py",
+                   "X = 1  # lint: lockset-ok would be silently inert\n")
+    findings = telemetry.check(sources, {}, str(tmp_path))
+    assert len(findings) == 1
+    assert "unknown lint rule 'lockset'" in findings[0].message
+
+
+def test_baseline_cli_round_trip(tmp_path, capsys):
+    """e2e: --write-baseline records the (clean) tree, --baseline
+    compares against it, both exit 0."""
+    base = tmp_path / "base.json"
+    rc = cli.main(["--root", ROOT, "--pass", "guarded-by",
+                   "--baseline", str(base), "--write-baseline"])
+    assert rc == 0
+    assert base.exists()
+    rc = cli.main(["--root", ROOT, "--pass", "guarded-by",
+                   "--baseline", str(base)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_dump_graph_emits_guarded_by_table(capsys):
+    rc = cli.main(["--root", ROOT, "--dump-graph"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "guarded-by table" in out
+    assert "SocketParameterServer._clock <- SocketParameterServer._lock" in out
+    assert "ReplicationFeed._lock -> SocketParameterServer._lock" in out
+
+
+# -- TSAN wiring (ISSUE 14 sanitizer cell) -------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.tsan
+def test_native_hub_is_tsan_clean(tmp_path):
+    """Compile the C++ hub with ``-fsanitize=thread`` together with the
+    ``native/tsan_stress.cpp`` driver (sparse+adaptive primary, hot
+    standby, inproc committers, socket pull/commit, sparse S/V/U, G/Y
+    backpressure, M health, telemetry poller — concurrently) and fail
+    on ANY ThreadSanitizer report.  This cell caught (and now pins the
+    fixes for) the unsynchronized ``listen_fd_`` stop/accept race."""
+    from conftest import require_tool
+
+    require_tool("g++")
+    probe = tmp_path / "probe.cpp"
+    probe.write_text("int main() { return 0; }\n")
+    if subprocess.run(["g++", "-fsanitize=thread", str(probe), "-o",
+                       str(tmp_path / "probe")],
+                      capture_output=True).returncode != 0:
+        pytest.skip("g++ lacks -fsanitize=thread (no libtsan)")
+    driver = tmp_path / "tsan_driver"
+    build = subprocess.run(
+        ["g++", "-fsanitize=thread", "-O1", "-g", "-pthread", "-std=c++17",
+         "-ffp-contract=off",
+         os.path.join(ROOT, "native", "ps_server.cpp"),
+         os.path.join(ROOT, "native", "tsan_stress.cpp"),
+         "-o", str(driver)],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ,
+               TSAN_OPTIONS="exitcode=66 halt_on_error=0")
+    proc = subprocess.run([str(driver)], capture_output=True, text=True,
+                          timeout=240, env=env)
+    out = proc.stdout + proc.stderr
+    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+
+
+def test_baseline_usage_errors_and_subset_write_preserves(tmp_path, capsys):
+    """A missing/corrupt --baseline file is a usage error (exit 2, not a
+    findings failure CI would misread), and --write-baseline with a
+    --pass subset preserves the other passes' recorded suppressions."""
+    missing = tmp_path / "nope.json"
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--root", ROOT, "--pass", "guarded-by",
+                  "--baseline", str(missing)])
+    assert e.value.code == 2
+    capsys.readouterr()
+    torn = tmp_path / "torn.json"
+    torn.write_text("{not json")
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--root", ROOT, "--pass", "guarded-by",
+                  "--baseline", str(torn)])
+    assert e.value.code == 2
+    capsys.readouterr()
+    # subset refresh: a recorded telemetry entry survives a guarded-by
+    # only --write-baseline (its pass did not run)
+    base = tmp_path / "base.json"
+    cli.write_baseline(str(base), {"telemetry": [
+        Finding("telemetry", "pkg/x.py", 3, "bad name")]})
+    rc = cli.main(["--root", ROOT, "--pass", "guarded-by",
+                   "--baseline", str(base), "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    assert ("telemetry", "pkg/x.py", "bad name") in cli.load_baseline(
+        str(base))
+
+
+def test_lockset_instrument_skips_listed_subclasses():
+    """Listing a base AND its subclass must not double-patch: each write
+    on a subclass instance is observed exactly once (the inherited
+    patched __setattr__ already covers it)."""
+    import threading
+
+    class Base:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+    class Sub(Base):
+        pass
+
+    with lockset.instrument(Base, Sub) as chk:
+        s = Sub()
+        s._n = 1
+        s._n = 2
+    assert chk.writes_checked == 3  # __init__'s _n=0 plus two stores
+    # and both classes are fully restored
+    assert "__setattr__" not in Base.__dict__
+    assert "__setattr__" not in Sub.__dict__

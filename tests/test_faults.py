@@ -400,6 +400,43 @@ def test_hub_snapshot_skips_corrupt_latest(tmp_path):
         ps2.stop()
 
 
+def test_restore_racing_save_loop_never_loses_a_step(tmp_path):
+    """Guarded-by regression (ISSUE 14): ``restore_latest`` advances
+    ``_next_step`` under the save lock, so a restore racing the periodic
+    save loop cannot lose-update the step counter — every concurrent
+    save_now lands on a distinct step directory."""
+    import threading
+
+    snap_dir = str(tmp_path / "snaps")
+    ps = DeltaParameterServer(_weights(), snapshot_dir=snap_dir,
+                              snapshot_interval=60.0)
+    ps.start()
+    ps.commit_direct(_ones(), 0)
+    ps.snapshotter.save_now()
+    stop = threading.Event()
+    errors = []
+
+    def saver():
+        try:
+            while not stop.is_set():
+                ps.snapshotter.save_now()
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    t = threading.Thread(target=saver)
+    t.start()
+    try:
+        for _ in range(20):
+            assert ps.snapshotter.restore_latest()
+    finally:
+        stop.set()
+        t.join()
+        ps.kill()
+    assert not errors, errors
+    steps = sorted(int(d.split("_")[-1]) for d in os.listdir(snap_dir))
+    assert steps and ps.snapshotter._next_step > max(steps)
+
+
 def test_restore_refuses_when_snapshots_exist_but_none_readable(tmp_path):
     """Progress on disk that cannot be read must stop the hub, not let it
     silently serve fresh weights; an EMPTY dir (first boot under a

@@ -21,6 +21,7 @@ def test_forward_shapes(spec_fn, batch_shape, out_shape):
     assert model.apply(x).shape == out_shape
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14 satellite): 8.1 s: compiles the full ResNet-20 graph; transformer/cnn forwards keep model coverage in tier-1
 def test_resnet20_forward():
     model = Model.init(resnet20_spec(num_outputs=100), seed=0)
     x = np.zeros((2, 32, 32, 3), dtype=np.float32)

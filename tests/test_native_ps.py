@@ -737,12 +737,12 @@ def test_native_build_is_warning_clean():
     compile with -Wall -Wextra -Werror — a warning is a failed test, not
     line noise."""
     import os
-    import shutil
     import subprocess
     import tempfile
 
-    if shutil.which("g++") is None:
-        pytest.skip("no g++ in this container")
+    from conftest import require_tool
+
+    require_tool("g++")
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     from distkeras_tpu.runtime.native import BUILD_FLAGS
 

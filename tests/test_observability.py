@@ -442,6 +442,22 @@ def hub_and_templates():
     ps.stop()
 
 
+def _wait_spans(*names, timeout=5.0):
+    """The hub acks INSIDE the handler span, so a client can unblock
+    before the span records (the ack-before-telemetry-tail ordering,
+    ISSUE 14's motivating shape) — poll briefly instead of racing."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        events = obs.TRACER.events()
+        got = {n: [e for e in events if e["name"] == n] for n in names}
+        if all(got.values()):
+            return got
+        _time.sleep(0.01)
+    return got
+
+
 def test_trace_context_announce_tags_hub_spans(telemetry, hub_and_templates):
     from distkeras_tpu.observability import distributed as dtrace
     from distkeras_tpu.runtime.parameter_server import PSClient
@@ -457,8 +473,8 @@ def test_trace_context_announce_tags_hub_spans(telemetry, hub_and_templates):
         # tiny, and within the sample's own error bound
         assert client.clock_error_ns is not None
         assert abs(client.clock_offset_ns) <= client.clock_error_ns + 5_000_000
-    commits = [e for e in obs.TRACER.events() if e["name"] == "ps.handle_commit"]
-    pulls = [e for e in obs.TRACER.events() if e["name"] == "ps.handle_pull"]
+    got = _wait_spans("ps.handle_commit", "ps.handle_pull")
+    commits, pulls = got["ps.handle_commit"], got["ps.handle_pull"]
     assert commits and pulls
     assert commits[0]["attrs"]["worker"] == 4
     assert commits[0]["attrs"]["job"] == "j1"
@@ -474,8 +490,7 @@ def test_unannounced_client_wire_unchanged(telemetry, hub_and_templates):
     ps, templates = hub_and_templates
     with PSClient("127.0.0.1", ps.port, templates=templates) as client:
         client.commit([np.ones_like(t) for t in templates])
-    (commit,) = [e for e in obs.TRACER.events()
-                 if e["name"] == "ps.handle_commit"]
+    (commit,) = _wait_spans("ps.handle_commit")["ps.handle_commit"]
     assert "worker" not in commit["attrs"]
 
 

@@ -3,6 +3,8 @@
 sharding, client caches + int8 dense-residual fallback, trainer threading,
 wire-compat matrix (recording sockets), and sparse-vs-dense bit-parity."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -687,9 +689,19 @@ def test_sparse_sharded_telemetry_is_shard_labeled():
             c.pull()
             d = [np.ones((10, 4), np.float32), np.ones((3,), np.float32)]
             c.commit(d, sparse_rows=[np.array([1, 8])])  # one id per range
-        snap = obs.snapshot()
-        for sid in ("0", "1"):
-            key = f'ps.sparse_rows_committed{{shard="{sid}"}}'
+        # the hub acks a commit BEFORE its telemetry tail runs (ack
+        # latency beats counter bumps by design), so an immediate
+        # snapshot races the handler thread — poll briefly (the exact
+        # unguarded-read-after-ack shape ISSUE 14 is about)
+        keys = [f'ps.sparse_rows_committed{{shard="{sid}"}}'
+                for sid in ("0", "1")]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = obs.snapshot()
+            if all(snap["counters"].get(k) == 1.0 for k in keys):
+                break
+            time.sleep(0.01)
+        for key in keys:
             assert snap["counters"].get(key) == 1.0, snap["counters"]
     finally:
         ps.stop()
