@@ -2619,6 +2619,98 @@ def _bench_embedding(*, rows: int = 25600, dim: int = 128, fields: int = 2,
                 if n_windows * rows else None)
         return out
 
+    def hot_leg(hot_fraction=0.01, hot_prob=0.9):
+        """The issue-15 cold-start + skewed-access leg: a hot/cold CTR
+        draw, a hot-tier client cache sized to ~2x the hot set, and a
+        sparse-capable standby attached to the hub — so the leg measures
+        the THREE hyperscale edges at once: client cache memory (bounded
+        LRU vs full table), replication bytes (REPL_SPARSE row deltas vs
+        the dense-R equivalent) and the cache hit economics (cold start
+        misses, warm hits at skew)."""
+        from distkeras_tpu.models.base import sparse_leaf_indices
+        from distkeras_tpu.runtime.parameter_server import (
+            ADAGParameterServer)
+
+        ds_hot = synthetic_ctr_dataset(n, rows, fields=fields, seed=0,
+                                       hot_fraction=hot_fraction,
+                                       hot_prob=hot_prob)
+        hot_rows = max(1, int(round(rows * hot_fraction)))
+        cache_rows = min(rows, 2 * hot_rows)
+        model = Model.init(spec, seed=0)
+        flat_w = [np.asarray(w, np.float32)
+                  for w in flatten_weights(model.params)[0]]
+        sparse_idx = sparse_leaf_indices(spec, model.params)
+        hub = ADAGParameterServer(flat_w, num_workers=workers,
+                                  idle_timeout=None,
+                                  sparse_leaves=sparse_idx)
+        # bench runs are short: decay (and publish) the hot-set estimate
+        # every few folds so the leg records a non-None estimate
+        hub.TOUCH_DECAY_EVERY = 8
+        hub.start()
+        standby = ADAGParameterServer(flat_w, num_workers=workers,
+                                      idle_timeout=None,
+                                      sparse_leaves=sparse_idx,
+                                      replica_of=("127.0.0.1", hub.port))
+        standby.start()
+        try:
+            if not standby.wait_synced(30):
+                raise RuntimeError("hot leg: standby never synced")
+            tr = AsyncADAG(model, loss="categorical_crossentropy",
+                           batch_size=batch, num_epoch=epochs,
+                           learning_rate=0.05, seed=0,
+                           num_workers=workers,
+                           communication_window=window,
+                           sparse_tables="auto",
+                           sparse_cache_rows=cache_rows,
+                           ps_address=("127.0.0.1", hub.port))
+            obs.enable()
+            obs.reset()
+            t0 = time.perf_counter()
+            tr.train(ds_hot, shuffle=False)
+            wall = time.perf_counter() - t0
+            counters = dict(obs.snapshot()["counters"])
+            gauges = dict(obs.snapshot()["gauges"])
+            obs.disable()
+            obs.reset()
+            repl_bytes = hub._feed.repl_sparse_bytes if hub._feed else 0
+            saved = sum(v for k, v in counters.items()
+                        if k.startswith("ps.repl_sparse_bytes_saved"))
+            hits = sum(v for k, v in counters.items()
+                       if k.startswith("ps_sparse_cache_hits_total"))
+            misses = sum(v for k, v in counters.items()
+                         if k.startswith("ps_sparse_cache_misses_total"))
+            committed = sum(v for k, v in counters.items()
+                            if k.startswith("ps.sparse_rows_committed"))
+            hot_est = [v for k, v in gauges.items()
+                       if k.startswith("ps.sparse_hot_rows")]
+            commits = counters.get("ps_commits_total", 0.0)
+            table_bytes = rows * dim * 4
+            return {
+                "wall_s": round(wall, 3),
+                "hot_fraction": hot_fraction, "hot_prob": hot_prob,
+                "cache_rows": cache_rows,
+                # per-worker host bytes the hot tier holds vs the full
+                # table cache a PR-9 client would hold
+                "cache_bytes": cache_rows * dim * 4,
+                "full_cache_bytes": table_bytes,
+                "cache_memory_ratio": round(cache_rows / rows, 5),
+                "cache_hits": round(hits), "cache_misses": round(misses),
+                "cache_hit_rate": (round(hits / (hits + misses), 4)
+                                   if hits + misses else None),
+                "repl_sparse_bytes": round(repl_bytes),
+                "repl_bytes_saved": round(saved),
+                "repl_dense_equiv_bytes": round(repl_bytes + saved),
+                "rows_committed": round(committed),
+                "hot_rows_estimate": (round(max(hot_est))
+                                      if hot_est else None),
+                "touched_row_fraction": (
+                    round(committed / (commits * rows), 5)
+                    if commits and rows else None),
+            }
+        finally:
+            standby.stop()
+            hub.stop()
+
     was_enabled = obs.enabled()
     out = {"rows": rows, "dim": dim, "fields": fields, "batch": batch,
            "window": window, "epochs": epochs, "workers": workers,
@@ -2628,6 +2720,10 @@ def _bench_embedding(*, rows: int = 25600, dim: int = 128, fields: int = 2,
     try:
         out["dense"] = leg(False)
         out["sparse"] = leg(True)
+        try:
+            out["hot"] = hot_leg()
+        except Exception as e:  # the hot leg must not axe the PR-9 legs
+            out["hot"] = {"error": f"{type(e).__name__}: {e}"}
     finally:
         if was_enabled:
             obs.enable()
@@ -2638,12 +2734,19 @@ def _bench_embedding(*, rows: int = 25600, dim: int = 128, fields: int = 2,
 
 
 def _embedding_acceptance(out: dict) -> None:
-    """Attach the issue-9 tripwires, in place: the sparse leg's steady-
-    state exchange bytes under ``1.1 x touched_fraction`` of the dense
-    leg's, with a rows/s figure recorded.  Booleans, or None when a leg
-    is missing/errored (graceful degradation, the PR-3 convention)."""
+    """Attach the issue-9 + issue-15 tripwires, in place: the sparse
+    leg's steady-state exchange bytes under ``1.1 x touched_fraction``
+    of the dense leg's, with a rows/s figure recorded; the hot leg's
+    replication bytes under ``1.1 x touched_fraction`` of the dense-R
+    equivalent, its client cache memory scaling with the hot fraction
+    (cache/table ratio <= 4x the hot fraction by construction of the
+    2x-hot-set sizing, asserted anyway against drift), and a warm hit
+    rate that shows the hot tier actually absorbing the skew.  Booleans,
+    or None when a leg is missing/errored (graceful degradation, the
+    PR-3 convention)."""
     dense = out.get("dense") if isinstance(out.get("dense"), dict) else {}
     sparse = out.get("sparse") if isinstance(out.get("sparse"), dict) else {}
+    hot = out.get("hot") if isinstance(out.get("hot"), dict) else {}
     dense_bytes = dense.get("exchange_bytes")
     sparse_bytes = sparse.get("exchange_bytes")
     frac = sparse.get("touched_row_fraction")
@@ -2651,6 +2754,15 @@ def _embedding_acceptance(out: dict) -> None:
              if sparse_bytes and dense_bytes else None)
     bound = round(1.1 * frac, 5) if frac else None
     rows_per_s = sparse.get("rows_per_s")
+    repl = hot.get("repl_sparse_bytes")
+    repl_equiv = hot.get("repl_dense_equiv_bytes")
+    hot_frac = hot.get("touched_row_fraction")
+    repl_ratio = (round(repl / repl_equiv, 5)
+                  if repl and repl_equiv else None)
+    repl_bound = round(1.1 * hot_frac, 5) if hot_frac else None
+    cache_ratio = hot.get("cache_memory_ratio")
+    hot_fraction = hot.get("hot_fraction")
+    hit_rate = hot.get("cache_hit_rate")
     out["acceptance"] = {
         "wire_ratio": ratio,
         "wire_ratio_bound": bound,
@@ -2660,6 +2772,18 @@ def _embedding_acceptance(out: dict) -> None:
         "rows_per_s": rows_per_s,
         "rows_per_s_recorded": (None if rows_per_s is None
                                 else bool(rows_per_s > 0)),
+        # -- issue-15 hyperscale tripwires --------------------------------
+        "repl_ratio": repl_ratio,
+        "repl_ratio_bound": repl_bound,
+        "repl_sparse_ok": (None if repl_ratio is None or repl_bound is None
+                           else bool(repl_ratio <= repl_bound)),
+        "cache_memory_ratio": cache_ratio,
+        "cache_memory_ok": (None if cache_ratio is None
+                            or not hot_fraction
+                            else bool(cache_ratio <= 4.0 * hot_fraction)),
+        "cache_hit_rate": hit_rate,
+        "cache_hit_ok": (None if hit_rate is None
+                         else bool(hit_rate >= 0.3)),
     }
 
 

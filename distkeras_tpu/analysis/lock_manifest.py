@@ -135,6 +135,17 @@ GUARDED_BY: Dict[str, Tuple[Optional[str], str]] = {
         ("SnapshotSetCoordinator._save_lock", ""),
     # -- adaptive plane
     "AdaptiveRateController._scales": ("AdaptiveRateController._lock", ""),
+    # -- hyperscale embedding tier (ISSUE 15)
+    "SocketParameterServer._touch_folds": ("SocketParameterServer._lock", ""),
+    "ReplicationFeed.repl_sparse_bytes": ("ReplicationFeed._lock", ""),
+    "VarFrameEncoder._tx": (None, (
+        "one encoder per connection/direction owner by documented "
+        "contract (the FlatFrameCodec._tx argument); the replication "
+        "feed's shared instance is additionally serialized by the feed "
+        "lock around every pack/send")),
+    "VarFrameEncoder.frame_len": (None, (
+        "same single-owner contract as VarFrameEncoder._tx — frame_len "
+        "is the most-recent-pack bookkeeping of that same buffer")),
     # -- client pipeline state: the io lock serializes the FIFO and owns
     #    the freshness clock the heartbeat reads
     "PSClient._last_io": ("PSClient._io_lock", ""),

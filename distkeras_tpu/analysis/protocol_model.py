@@ -106,6 +106,11 @@ STANDBY_RULES: Dict[str, Any] = {
     "loss_exhaustion_promotes": True,
     # an ack may only leave a standby AFTER promotion
     "ack_requires_promoted": True,
+    # a REPL_SPARSE row-delta frame may only be sent to a standby whose
+    # hello announced REPL_CAP_SPARSE (attach-time capability, ISSUE 15);
+    # a legacy standby keeps receiving the dense-materialized delta
+    # stream — never a frame kind it cannot parse (a torn stream)
+    "sparse_delta_requires_cap": True,
 }
 
 
@@ -329,13 +334,29 @@ def _session_finding(msg: str, trace: Tuple[str, ...]) -> Finding:
 def explore_standby(rules: Optional[Dict[str, Any]] = None,
                     retries: int = 2, max_commits: int = 3
                     ) -> List[Finding]:
-    """Exhaustive walk of the standby lifecycle: R sync-then-delta feed,
-    feed loss + bounded retries, worker commits racing all of it.
-    Checks promotion reachability, the acked-while-standby invariant,
-    and deadlock freedom."""
+    """Exhaustive walk of the standby lifecycle: R sync-then-delta feed
+    (dense AND row-sparse frames, per the standby's attach-time
+    capability), feed loss + bounded retries, worker commits racing all
+    of it.  Checks promotion reachability, the acked-while-standby
+    invariant, the sparse-frame-capability invariant (a legacy standby
+    is never sent a REPL_SPARSE frame — ISSUE 15's never-a-torn-stream
+    rule), and deadlock freedom.  Both capability generations are
+    explored."""
     rules = dict(STANDBY_RULES if rules is None else rules)
     findings: List[Finding] = []
-    # state: (synced, feed_up, failures, promoted, commits_left)
+    for sparse_cap in (False, True):
+        findings.extend(_explore_standby_cap(rules, sparse_cap, retries,
+                                             max_commits))
+        if len(findings) >= 8:
+            break
+    return findings
+
+
+def _explore_standby_cap(rules: Dict[str, Any], sparse_cap: bool,
+                         retries: int, max_commits: int) -> List[Finding]:
+    findings: List[Finding] = []
+    # state: (synced, feed_up, failures, promoted, commits_left);
+    # sparse_cap is attach-time immutable, so it parameterizes the walk
     init = (False, True, 0, False, max_commits)
     seen = {init}
     frontier: List[Tuple[Tuple, Tuple[str, ...]]] = [(init, ())]
@@ -355,6 +376,12 @@ def explore_standby(rules: Optional[Dict[str, Any]] = None,
                 events.append(("feed_sync", state, None))
             if synced:
                 events.append(("feed_delta", state, None))
+                # the primary frames a row-sparse commit REPL_SPARSE only
+                # toward capable replicas; with the rule intact the event
+                # is simply not enabled for a legacy standby (it receives
+                # the densified REPL_DELTA above instead)
+                if sparse_cap or not rules["sparse_delta_requires_cap"]:
+                    events.append(("feed_sparse_delta", state, None))
             events.append(("feed_loss",
                            (synced, False, failures, promoted, commits_left),
                            None))
@@ -411,6 +438,15 @@ def explore_standby(rules: Optional[Dict[str, Any]] = None,
                     "protocol", SELF_PATH, 1,
                     f"acked-commit-while-standby: event {name} acks a "
                     f"commit but the hub is neither primary nor promoted "
+                    f"(trace: {' -> '.join(trace[-5:] + (name,))})"))
+                continue
+            if name == "feed_sparse_delta" and not sparse_cap:
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"sparse-frame-to-legacy-standby: a REPL_SPARSE frame "
+                    f"reaches a standby that never announced "
+                    f"REPL_CAP_SPARSE — a torn stream on the dense-R "
+                    f"fallback path "
                     f"(trace: {' -> '.join(trace[-5:] + (name,))})"))
                 continue
             if nstate not in seen:

@@ -52,6 +52,11 @@ TELEMETRY_NAMES = frozenset({
     "ps.backpressure_waits", "ps.stripe_lost",
     "ps.sparse_rows_pulled", "ps.sparse_rows_committed",
     "ps.sparse_wire_bytes_saved",
+    # hyperscale embedding tier (ISSUE 15): hub hot-set estimate, client
+    # hot-tier cache standing, sparse replication savings
+    "ps.sparse_hot_rows",
+    "ps_sparse_cache_hits_total", "ps_sparse_cache_misses_total",
+    "ps.repl_sparse_bytes_saved",
     # -- worker / health planes ------------------------------------------------
     "worker.restarts",
     "health.event",

@@ -25,17 +25,48 @@ import numpy as np
 from distkeras_tpu.data.dataset import Dataset
 
 
-def synthetic_ctr_dataset(n: int, rows: int, fields: int = 4, seed: int = 0,
+def synthetic_ctr_dataset(n: int, rows, fields: int = 4, seed: int = 0,
                           hot_fraction: float = 0.01,
                           hot_prob: float = 0.9) -> Dataset:
     """``n`` impressions over a ``rows``-id vocabulary: int32 ``features``
     ``[n, fields]`` and one-hot float32 ``label`` ``[n, 2]``
-    (click / no-click)."""
+    (click / no-click).
+
+    ``rows`` as an int draws every field from ONE shared vocabulary (the
+    PR-9 contract, unchanged); a SEQUENCE gives each field its own
+    independent vocabulary size (``fields`` is then implied) — the
+    multi-table shape ``ctr_embedding_spec(rows=[...])`` trains on, with
+    the same two-tier hot/cold skew applied per field."""
     if not 0.0 < hot_fraction <= 1.0:
         raise ValueError(f"hot_fraction must be in (0, 1], got {hot_fraction}")
     if not 0.0 <= hot_prob <= 1.0:
         raise ValueError(f"hot_prob must be in [0, 1], got {hot_prob}")
     rng = np.random.default_rng(seed)
+    if isinstance(rows, (list, tuple)):
+        # multi-vocabulary draw: per-field id streams and per-field
+        # propensity tables (a fresh code path — the scalar branch below
+        # stays stream-for-stream identical to PR 9's generator)
+        per_field = [int(r) for r in rows]
+        fields = len(per_field)
+        shape = (int(n), int(fields))
+        is_hot = rng.random(shape) < hot_prob
+        cols = []
+        for f, r in enumerate(per_field):
+            hot = max(1, min(r, int(round(r * hot_fraction))))
+            cols.append(np.where(is_hot[:, f],
+                                 rng.integers(0, hot, size=int(n)),
+                                 rng.integers(0, r, size=int(n))))
+        ids = np.stack(cols, axis=1).astype(np.int32)
+        logits = np.zeros(int(n), np.float32)
+        for f, r in enumerate(per_field):
+            propensity = rng.normal(scale=1.0 / np.sqrt(fields),
+                                    size=r).astype(np.float32)
+            logits += propensity[ids[:, f]]
+        p_click = 1.0 / (1.0 + np.exp(-logits))
+        clicks = (rng.random(int(n)) < p_click).astype(np.int64)
+        label = np.eye(2, dtype=np.float32)[clicks]
+        return Dataset({"features": ids, "label": label})
+    rows = int(rows)
     hot = max(1, min(int(rows), int(round(rows * hot_fraction))))
     shape = (int(n), int(fields))
     is_hot = rng.random(shape) < hot_prob

@@ -42,6 +42,18 @@ def main(argv=None) -> None:
     parser.add_argument("--workers", type=int, default=2)
     parser.add_argument("--hot-fraction", type=float, default=0.01,
                         help="fraction of ids receiving most traffic")
+    parser.add_argument("--cache-rows", type=int, default=None,
+                        help="hot-tier client cache size in rows (ISSUE "
+                             "15): bound each worker's host cache to this "
+                             "many rows per table instead of the full "
+                             "vocabulary; size it from the hub's "
+                             "ps.sparse_hot_rows estimate (~2x the hot "
+                             "set)")
+    parser.add_argument("--vocab-sizes", type=str, default=None,
+                        help="comma-separated per-field vocabulary sizes "
+                             "(ISSUE 15 multi-table mode: one independent "
+                             "embedding table per field; overrides --rows/"
+                             "--fields)")
     args = parser.parse_args(argv)
 
     from distkeras_tpu import observability as obs
@@ -51,14 +63,19 @@ def main(argv=None) -> None:
     from distkeras_tpu.models.embedding import ctr_embedding_spec
     from distkeras_tpu.runtime.async_trainer import AsyncADAG
 
-    ds = synthetic_ctr_dataset(args.samples, args.rows, fields=args.fields,
+    if args.vocab_sizes:
+        vocabs = [int(v) for v in args.vocab_sizes.split(",")]
+        rows_spec, fields, total_rows = vocabs, len(vocabs), max(vocabs)
+    else:
+        rows_spec, fields, total_rows = args.rows, args.fields, args.rows
+    ds = synthetic_ctr_dataset(args.samples, rows_spec, fields=fields,
                                hot_fraction=args.hot_fraction, seed=0)
-    frac = touched_row_fraction(ds["features"], args.rows,
+    frac = touched_row_fraction(ds["features"], total_rows,
                                 args.batch_size, args.window)
-    print(f"CTR log: {args.samples} impressions, vocab {args.rows}, "
-          f"{args.fields} fields; one window touches "
-          f"~{100.0 * frac:.2f}% of the table's rows")
-    spec = ctr_embedding_spec(args.rows, dim=args.dim, fields=args.fields)
+    print(f"CTR log: {args.samples} impressions, vocab {rows_spec}, "
+          f"{fields} fields; one window touches "
+          f"~{100.0 * frac:.2f}% of the largest table's rows")
+    spec = ctr_embedding_spec(rows_spec, dim=args.dim, fields=fields)
 
     def run(sparse):
         obs.enable()
@@ -69,7 +86,9 @@ def main(argv=None) -> None:
                             num_epoch=args.epochs, learning_rate=0.05,
                             seed=0, num_workers=args.workers,
                             communication_window=args.window,
-                            sparse_tables="auto" if sparse else None)
+                            sparse_tables="auto" if sparse else None,
+                            sparse_cache_rows=(args.cache_rows if sparse
+                                               else None))
         model = trainer.train(ds, shuffle=False)
         snap = obs.snapshot()
         wire = (snap["counters"].get("ps_pull_bytes_total", 0.0)

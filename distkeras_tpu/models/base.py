@@ -71,6 +71,45 @@ def sparse_leaf_indices(spec: "ModelSpec", params: Any) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def sparse_table_fields(spec: "ModelSpec", params: Any):
+    """Per-table input-column declaration for MULTI-VOCABULARY sparse
+    architectures (ISSUE 15): which columns of the int-id feature matrix
+    feed each sparse embedding table.
+
+    The registered module class declares ``sparse_field_map`` — a dict
+    mapping a MODULE PATH SEGMENT (e.g. ``"table_1"``, the flax
+    submodule name that owns the table param) to the tuple of feature
+    columns indexing that table.  Returns the column tuples aligned with
+    :func:`sparse_leaf_indices` order, or ``None`` when the architecture
+    declares no map — the single-vocabulary contract, where every table
+    is indexed by EVERY column and all tables must share one row count
+    (the async trainers enforce that reduction).
+
+    Raises when a map exists but does not cover every sparse leaf: a
+    silently-defaulted table would send another vocabulary's ids."""
+    cls = _MODEL_REGISTRY.get(spec.name)
+    fmap = getattr(cls, "sparse_field_map", None)
+    if not fmap:
+        return None
+    names = set(sparse_param_names(spec))
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        last = path[-1] if path else None
+        key = getattr(last, "key", getattr(last, "name", None))
+        if key not in names or getattr(leaf, "ndim", 0) != 2:
+            continue
+        segs = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        owner = next((s for s in segs if s in fmap), None)
+        if owner is None:
+            raise ValueError(
+                f"architecture {spec.name!r} declares sparse_field_map "
+                f"{sorted(fmap)} but sparse leaf at {segs} matches no "
+                f"entry — every sparse table needs its column declaration")
+        out.append(tuple(int(c) for c in fmap[owner]))
+    return tuple(out)
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelSpec:
     """Declarative architecture record: registry name + config + input shape.

@@ -519,6 +519,9 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             adaptive = _adaptive_block(live_snap)
             if adaptive is not None:
                 report["adaptive"] = adaptive
+            hot = _hot_tier_block(live_snap)
+            if hot is not None:
+                report.setdefault("sparse", {})["hot_tier"] = hot
     report["coverage"] = _report_coverage(
         len(spans), window_spans, commits_total, commits_with_ctx,
         workers, live_snap)
@@ -547,6 +550,38 @@ def _adaptive_block(live_snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     if not scales and not merge:
         return None
     return {"active": True, "worker_scales": scales, "merge_queue": merge}
+
+
+def _hot_tier_block(live_snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """ISSUE 15: the hyperscale embedding tier's live state — per-worker
+    client cache HIT RATE (hits / (hits + misses), from the cumulative
+    series each hot-tier worker reports) and the hub pseudo-workers'
+    cumulative sparse replication bytes.  ``None`` when the run carries
+    no hot-tier series at all, so pre-ISSUE-15 reports stay
+    byte-identical."""
+    workers = live_snap.get("workers") or {}
+    rates: Dict[str, Any] = {}
+    repl_bytes = 0.0
+    seen = False
+    for w, entry in workers.items():
+        metrics = entry.get("metrics") or {}
+        h = metrics.get("sparse_cache_hits_total")
+        m = metrics.get("sparse_cache_misses_total")
+        if (h and h.get("n")) or (m and m.get("n")):
+            hits = (h or {}).get("last") or 0.0
+            misses = (m or {}).get("last") or 0.0
+            total = hits + misses
+            rates[w] = {"hits": hits, "misses": misses,
+                        "hit_rate": (round(hits / total, 4) if total
+                                     else None)}
+            seen = True
+        r = metrics.get("repl_sparse_bytes_total")
+        if r and r.get("n"):
+            repl_bytes += r.get("last") or 0.0
+            seen = True
+    if not seen:
+        return None
+    return {"cache": rates, "repl_sparse_bytes_total": round(repl_bytes)}
 
 
 def _report_coverage(n_spans: int, window_spans: int, commits_total: int,

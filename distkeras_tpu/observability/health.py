@@ -648,7 +648,7 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         f"{len(events)} event(s)  [{time.strftime('%H:%M:%S')}]",
         f"{'WORKER':>8} {'SHARD':>5} {'WIN/S':>7} {'WALL MS':>9} "
         f"{'P95 MS':>9} {'STALE':>6} {'SCALE':>6} {'RECON':>6} "
-        f"{'ROW/S':>8} {'MQ':>4} {'AGE S':>6}",
+        f"{'ROW/S':>8} {'HIT%':>5} {'RΔ/S':>8} {'MQ':>4} {'AGE S':>6}",
     ]
 
     def sort_key(item):
@@ -671,6 +671,18 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         # hub pseudo-worker rows); "-" when the hub is not adaptive
         scale = m.get("adaptive_scale") or {}
         mq = m.get("merge_queue_depth") or {}
+        # hyperscale embedding tier (ISSUE 15): HIT% = the worker's
+        # hot-tier client cache hit rate (cumulative hits/misses series);
+        # RΔ/S = sparse replication bytes per second (the hub
+        # pseudo-worker's cumulative repl_sparse_bytes_total series).
+        # "-" for fleets that run dense, full-cache, or unreplicated
+        hits = (m.get("sparse_cache_hits_total") or {}).get("last")
+        misses = (m.get("sparse_cache_misses_total") or {}).get("last")
+        hit_pct = None
+        if hits is not None or misses is not None:
+            total = (hits or 0.0) + (misses or 0.0)
+            hit_pct = (100.0 * (hits or 0.0) / total) if total else None
+        repl = m.get("repl_sparse_bytes_total") or {}
         lines.append(
             f"{w:>8} {_fmt(meta.get('shard')):>5} "
             f"{_fmt(windows.get('rate'), 2):>7} "
@@ -679,6 +691,8 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
             f"{_fmt(scale.get('last'), 2):>6} "
             f"{_fmt(recon.get('last'), 0):>6} "
             f"{_fmt(sparse.get('rate'), 0):>8} "
+            f"{_fmt(hit_pct, 1):>5} "
+            f"{_fmt(repl.get('rate'), 0):>8} "
             f"{_fmt(mq.get('last'), 0):>4} "
             f"{_fmt(meta.get('age_s')):>6}")
     if events:

@@ -127,6 +127,7 @@ class AsyncDistributedTrainer(Trainer):
                  trace_context: Optional[str] = None,
                  health_interval_s: Optional[float] = None,
                  sparse_tables: Optional[Any] = None,
+                 sparse_cache_rows: Optional[int] = None,
                  adaptive: bool = False,
                  **kwargs):
         super().__init__(model, **kwargs)
@@ -325,17 +326,30 @@ class AsyncDistributedTrainer(Trainer):
         if sparse_tables is not None and sparse_tables != "auto":
             sparse_tables = tuple(sorted({int(i) for i in sparse_tables}))
         self.sparse_tables = sparse_tables
-        if sparse_tables is not None:
-            if native_ps and transport == "inproc":
-                # the ONE remaining Python-hub-only combination (ISSUE 11):
-                # the C++ hub serves the full sparse WIRE plane (S/V/U/X)
-                # but has no pull_sparse_direct/commit_sparse_direct pair
+        # (the former sparse+inproc+native guard is gone: the C++ hub now
+        # serves the sparse direct pair — dk_ps_pull_sparse /
+        # dk_ps_commit_sparse, ISSUE 15 — so every transport x hub cell
+        # composes with sparse_tables)
+        # hot-tier client caching (ISSUE 15): each worker's per-table
+        # host cache becomes a bounded LRU of sparse_cache_rows rows —
+        # hits are served locally (zero wire), misses fetched over the
+        # sparse pull, the window's compute consumes [k, dim] row blocks
+        # scattered into a device-resident mirror.  None (default) keeps
+        # the PR-9 full-cache path byte-identical
+        self.sparse_cache_rows = (None if sparse_cache_rows is None
+                                  else int(sparse_cache_rows))
+        if self.sparse_cache_rows is not None:
+            if sparse_tables is None:
+                raise ValueError("sparse_cache_rows needs sparse_tables "
+                                 "(there is no sparse exchange to cache)")
+            if self.sparse_cache_rows < 1:
+                raise ValueError(f"sparse_cache_rows must be >= 1, got "
+                                 f"{self.sparse_cache_rows}")
+            if self.num_shards > 1:
                 raise ValueError(
-                    "sparse_tables with transport='inproc' requires the "
-                    "Python hub (native_ps=False): the C++ hub has no "
-                    "sparse inproc direct pair — use transport='socket' "
-                    "(native sparse is served over the S/V/U/X wire "
-                    "actions) or drop native_ps")
+                    "sparse_cache_rows requires num_shards=1: the striped "
+                    "client's sparse design is row-range views of one "
+                    "full-size cache (see MIGRATION.md)")
         # telemetry-driven adaptive aggregation (ISSUE 10), off by
         # default.  On: the trainer-owned hub merges queued commits
         # Adasum-style, scales each worker's commits by its live
@@ -410,16 +424,39 @@ class AsyncDistributedTrainer(Trainer):
             if flat[i].ndim != 2:
                 raise ValueError(f"sparse_tables leaf {i} must be a "
                                  f"[rows, dim] table, got {flat[i].shape}")
-        # the worker loop sends ONE shared id set to every sparse table
-        # (the shared-vocabulary contract), so unequal row counts would
-        # only surface as a mid-run ValueError on the first out-of-range
-        # id — refuse at setup instead
-        row_counts = {flat[i].shape[0] for i in declared}
-        if len(row_counts) > 1:
-            raise ValueError(
-                f"sparse_tables leaves have mismatched row counts "
-                f"{sorted(row_counts)}: all sparse tables must share one "
-                f"vocabulary (the worker sends one id set per window)")
+        # per-table vocabularies (ISSUE 15): an architecture declaring a
+        # sparse_field_map gets an INDEPENDENT id set per table — each
+        # table's ids come from its own feature columns and validate
+        # against its own row count, so vocabularies may differ freely.
+        # Without a map the PR-9 shared-vocabulary contract stands: one
+        # id set per window feeds every table, so unequal row counts
+        # would only surface as a mid-run ValueError on the first
+        # out-of-range id — refuse at setup instead
+        from distkeras_tpu.models.base import (sparse_leaf_indices,
+                                               sparse_table_fields)
+
+        fields = sparse_table_fields(self.model.spec, self.model.params)
+        if fields is not None:
+            by_leaf = dict(zip(sparse_leaf_indices(self.model.spec,
+                                                   self.model.params),
+                               fields))
+            missing = [i for i in declared if i not in by_leaf]
+            if missing:
+                raise ValueError(
+                    f"sparse_tables leaves {missing} have no "
+                    f"sparse_field_map entry on architecture "
+                    f"{self.model.spec.name!r} — every per-vocabulary "
+                    f"table needs its column declaration")
+            fields = tuple(by_leaf[i] for i in declared)
+        self._sparse_fields = fields
+        if fields is None:
+            row_counts = {flat[i].shape[0] for i in declared}
+            if len(row_counts) > 1:
+                raise ValueError(
+                    f"sparse_tables leaves have mismatched row counts "
+                    f"{sorted(row_counts)}: tables sharing one id set must "
+                    f"share one vocabulary — declare a sparse_field_map "
+                    f"on the architecture for per-table vocabularies")
         return declared
 
     def _allocate_hub(self, weights: List[np.ndarray],
@@ -679,7 +716,8 @@ class AsyncDistributedTrainer(Trainer):
                 client = InprocPSClient(ps, templates=flat0,
                                         compress=self.compress_commits,
                                         trace_context=ctx,
-                                        sparse_leaves=sparse_idx)
+                                        sparse_leaves=sparse_idx,
+                                        sparse_cache_rows=self.sparse_cache_rows)
             elif plan is not None:
                 # striped worker: one pipelined connection per shard,
                 # pulls/commits fan out and land per shard (the same
@@ -706,19 +744,29 @@ class AsyncDistributedTrainer(Trainer):
                                   failover=(self._ps_failover[0]
                                             if self._ps_failover else ()),
                                   sparse_leaves=sparse_idx,
-                                  adaptive=self.adaptive)
+                                  adaptive=self.adaptive,
+                                  sparse_cache_rows=self.sparse_cache_rows)
             pipeline = self.pipeline
             # row-sparse exchange (ISSUE 9): each window's pull/commit
-            # carries the sorted-unique row ids its batches touch — the
-            # same id set for every sparse table (the shared-vocabulary
-            # contract of the embedding_classifier family).  Fully inert
-            # when no sparse tables are configured
+            # carries the sorted-unique row ids its batches touch.
+            # Architectures with a sparse_field_map (ISSUE 15) get an
+            # INDEPENDENT id set per table from that table's own feature
+            # columns; the rest keep the shared-vocabulary contract (one
+            # id set for every table).  Fully inert when no sparse tables
+            # are configured
             sparse_on = bool(sparse_idx)
+            sparse_fields = getattr(self, "_sparse_fields", None)
+            cache_on = sparse_on and self.sparse_cache_rows is not None
 
             def rows_of(window_x) -> List[np.ndarray]:
-                ids = np.unique(np.asarray(window_x).ravel()
-                                .astype(np.int64))
-                return [ids] * len(sparse_idx)
+                x = np.asarray(window_x)
+                if sparse_fields is None:
+                    ids = np.unique(x.ravel().astype(np.int64))
+                    return [ids] * len(sparse_idx)
+                flat_x = x.reshape(-1, x.shape[-1])
+                return [np.unique(flat_x[:, list(cols)].ravel()
+                                  .astype(np.int64))
+                        for cols in sparse_fields]
             # live health plane (ISSUE 8): periodic compact reports to the
             # hub's collector.  Wholly inert when off (health_interval is
             # None -> zero extra calls on the window path)
@@ -747,6 +795,14 @@ class AsyncDistributedTrainer(Trainer):
                     # rows as a cumulative series (rate = rows/s in
                     # distkeras-top and the live fleet_report)
                     metrics["sparse_rows_total"] = float(h_rows)
+                if cache_on:
+                    # hot-tier cache standing (ISSUE 15): cumulative hit/
+                    # miss series — the HIT% column in distkeras-top and
+                    # fleet_report["sparse"]["hot_tier"]
+                    metrics["sparse_cache_hits_total"] = float(
+                        client.sparse_cache_hits)
+                    metrics["sparse_cache_misses_total"] = float(
+                        client.sparse_cache_misses)
                 client.report_health({
                     "job": trace_job or "local", "worker": idx,
                     "seq": h_seq, "t_wall": time.time(),
@@ -762,8 +818,24 @@ class AsyncDistributedTrainer(Trainer):
                 # by later prefetches, and params must own its storage.
                 # On a restart this pull IS the recovery point: the
                 # worker resumes from the hub's current center
-                params = jax.device_put(
-                    unflatten([np.array(w) for w in client.pull()]), device)
+                seed_host = [np.array(w) for w in client.pull()]
+                params = jax.device_put(unflatten(seed_host), device)
+                # hot-tier mode (ISSUE 15): one full-shape DEVICE-resident
+                # mirror per sparse table, seeded from the initial full
+                # pull and scatter-refreshed each window with the [k, dim]
+                # row block the bounded client cache hands back — the
+                # full-shape host copy the PR-9 path re-uploaded per
+                # window no longer exists, and per-window H2D for the
+                # table drops to the touched rows
+                sset = frozenset(sparse_idx)
+                mirror = ({i: jax.device_put(seed_host[i], device)
+                           for i in sparse_idx} if cache_on else None)
+                # the seed's host copy must NOT outlive the transfer: a
+                # named local would pin one full-size host array per
+                # sparse table for the whole run — the exact footprint
+                # sparse_cache_rows exists to eliminate
+                del seed_host
+                row_caps: Optional[List[int]] = None
                 opt_state = jax.device_put(self.optimizer.init(params), device)
                 # one pull rides ahead of the window being computed (set
                 # when the previous window prefetched this window's pull)
@@ -777,6 +849,18 @@ class AsyncDistributedTrainer(Trainer):
                                                window=self.communication_window)
                     xs, ys = stacked[self.features_col], stacked[self.label_col]
                     n_windows = xs.shape[0]
+                    if cache_on and row_caps is None:
+                        # fixed scatter capacity per table: distinct ids
+                        # per window are bounded by rows-per-window x the
+                        # table's column count, so padding to this bound
+                        # keeps the device scatter ONE compiled shape
+                        per_window = int(xs.shape[1])
+                        ncols = ([len(c) for c in sparse_fields]
+                                 if sparse_fields is not None
+                                 else [int(xs.shape[-1])] * len(sparse_idx))
+                        row_caps = [min(int(flat0[i].shape[0]),
+                                        per_window * nc)
+                                    for i, nc in zip(sparse_idx, ncols)]
                     # with telemetry ON, window slices ride the shared
                     # feed machinery with a no-op place: the producer
                     # thread stages (wx, wy) views one window ahead and
@@ -817,8 +901,59 @@ class AsyncDistributedTrainer(Trainer):
                             # ONE batched H2D per window (center + feed
                             # slices) — on a relayed device every transfer
                             # call is a host round trip, so they are fused
-                            pulled, wx, wy = jax.device_put(
-                                (unflatten(pulled_host), wx_h, wy_h), device)
+                            if cache_on:
+                                # sparse slots of pulled_host are [k, dim]
+                                # row blocks aligned with rows_w; pad each
+                                # to its fixed capacity (repeating the
+                                # last row — duplicate scatter indices
+                                # carry identical values) and refresh the
+                                # device mirrors, then assemble the full-
+                                # order pulled tree from mirrors + dense
+                                pads: List[Any] = []
+                                for si, i in enumerate(sparse_idx):
+                                    ids = rows_w[si]
+                                    k = int(ids.size)
+                                    if k == 0:
+                                        pads.append(None)
+                                        continue
+                                    block = np.asarray(pulled_host[i],
+                                                       np.float32)
+                                    cap = row_caps[si]
+                                    if k < cap:
+                                        pid = np.empty(cap, np.int64)
+                                        pid[:k] = ids
+                                        pid[k:] = ids[k - 1]
+                                        pblk = np.empty(
+                                            (cap, block.shape[1]),
+                                            np.float32)
+                                        pblk[:k] = block
+                                        pblk[k:] = block[k - 1]
+                                    else:
+                                        pid, pblk = ids, block
+                                    pads.append((pid, pblk))
+                                dense_host = [pulled_host[j]
+                                              for j in range(len(pulled_host))
+                                              if j not in sset]
+                                dense_dev, pad_dev, wx, wy = jax.device_put(
+                                    (dense_host, pads, wx_h, wy_h), device)
+                                flat_dev: List[Any] = []
+                                di = si = 0
+                                for j in range(len(pulled_host)):
+                                    if j in sset:
+                                        pd = pad_dev[si]
+                                        if pd is not None:
+                                            mirror[j] = mirror[j].at[
+                                                pd[0]].set(pd[1])
+                                        flat_dev.append(mirror[j])
+                                        si += 1
+                                    else:
+                                        flat_dev.append(dense_dev[di])
+                                        di += 1
+                                pulled = unflatten(flat_dev)
+                            else:
+                                pulled, wx, wy = jax.device_put(
+                                    (unflatten(pulled_host), wx_h, wy_h),
+                                    device)
                             t_dev = time.perf_counter() if telemetry else 0.0
                             params, opt_state, commit, mloss = window_fn(
                                 params, opt_state, pulled, wx, wy)

@@ -146,6 +146,14 @@ def _bind_ps(lib: ctypes.CDLL) -> None:
     lib.dk_ps_commit_ctx.restype = ctypes.c_int
     lib.dk_ps_commit_ctx.argtypes = [ctypes.c_void_p, P(ctypes.c_float),
                                      ctypes.c_int64, ctypes.c_int64]
+    lib.dk_ps_pull_sparse.restype = ctypes.c_int64
+    lib.dk_ps_pull_sparse.argtypes = [ctypes.c_void_p, P(ctypes.c_int64),
+                                      P(ctypes.c_int64), P(ctypes.c_float)]
+    lib.dk_ps_commit_sparse.restype = ctypes.c_int
+    lib.dk_ps_commit_sparse.argtypes = [ctypes.c_void_p, P(ctypes.c_float),
+                                        P(ctypes.c_int64), P(ctypes.c_int64),
+                                        ctypes.c_int64, ctypes.c_int64]
+    lib.dk_ps_hot_rows.argtypes = [ctypes.c_void_p, P(ctypes.c_int64)]
     lib.dk_ps_stats.argtypes = [ctypes.c_void_p, P(ctypes.c_int64)]
     lib.dk_ps_staleness_hist.argtypes = [ctypes.c_void_p, P(ctypes.c_int64)]
     lib.dk_ps_merge_hist.argtypes = [ctypes.c_void_p, P(ctypes.c_int64)]
@@ -530,7 +538,8 @@ class NativeParameterServer:
                   "merge_batches", "merged_commits", "max_merge_batch",
                   "backpressure_hints", "replica_frames", "promotions",
                   "health_reports_dropped", "is_standby", "promoted_flag",
-                  "promoted_at_clock", "synced")
+                  "promoted_at_clock", "synced",
+                  "repl_sparse_bytes", "repl_sparse_saved")
 
     # cumulative counters synced into the registry under the SAME names
     # the Python hub emits, so Prometheus/punchcard output is
@@ -550,7 +559,8 @@ class NativeParameterServer:
                       ("merged_commits", "ps_merged_commits_total"),
                       ("backpressure_hints", "ps_backpressure_hints_total"),
                       ("replica_frames", "ps_replica_frames_total"),
-                      ("promotions", "ps_promotions_total"))
+                      ("promotions", "ps_promotions_total"),
+                      ("repl_sparse_saved", "ps.repl_sparse_bytes_saved"))
 
     def stats(self) -> Dict[str, int]:
         """The C++ hub's cumulative counters, by name (see ``dk_ps_stats``
@@ -649,6 +659,14 @@ class NativeParameterServer:
                     merge.observe_n(slot,
                                     int(hist[slot]) - self._last_merge_hist[slot])
                     self._last_merge_hist[slot] = int(hist[slot])
+            if self.sparse_leaves:
+                # decayed hot-set estimates under the same gauge the
+                # Python hub emits (ISSUE 15 row-touch telemetry)
+                hot = (ctypes.c_int64 * len(self.sparse_leaves))()
+                self._lib.dk_ps_hot_rows(self._handle, hot)
+                for leaf, count in zip(self.sparse_leaves, hot):
+                    obs.gauge("ps.sparse_hot_rows", table=str(leaf),
+                              **self._mlabels).set(int(count))
         # commit log -> hub-side spans on the "native-hub" track
         self._consume_commit_log()
 
@@ -745,27 +763,130 @@ class NativeParameterServer:
                 "commit into a standby refused (not promoted yet; verifying "
                 "the primary — retry)")
 
-    # -- the ONE remaining Python-hub-only surface -----------------------------
-    # The C++ hub serves the full row-sparse wire plane (S/V/U/X), so
-    # sparse SOCKET runs are native-capable; only the sparse INPROC direct
-    # pair below is unported.  These two raises are asserted verbatim by
-    # tests/test_native_ps.py::test_not_implemented_messages_name_exact_combo.
+    # -- sparse in-process transport (ISSUE 15) --------------------------------
+    # The former last NotImplementedError pair: the C++ hub now serves
+    # the sparse direct exchange too (dk_ps_pull_sparse /
+    # dk_ps_commit_sparse, GIL released), so EVERY transport x hub cell
+    # composes with sparse_tables.  Semantics mirror the Python hub's
+    # pull_sparse_direct/commit_sparse_direct (the bit-parity matrix in
+    # tests/test_hyperscale.py pins the trajectories).
+
+    def _check_row_ids(self, ids, leaf: int) -> np.ndarray:
+        """The shared :func:`networking.check_row_ids` contract over this
+        hub's templates (canonicalized to a contiguous int64 array for
+        the ctypes boundary)."""
+        return net.check_row_ids(
+            np.ascontiguousarray(np.asarray(ids).ravel(), np.int64),
+            self._templates[leaf].shape[0], leaf)
+
+    def _pack_sparse_ids(self, ids_list):
+        """Validated (sorted-unique, in-bounds) id arrays -> one
+        concatenated int64 buffer + per-table counts."""
+        if len(ids_list) != len(self.sparse_leaves):
+            raise ValueError(f"got {len(ids_list)} id arrays, hub has "
+                             f"{len(self.sparse_leaves)} sparse tables")
+        norm = [self._check_row_ids(ids, i)
+                for ids, i in zip(ids_list, self.sparse_leaves)]
+        counts = (ctypes.c_int64 * max(1, len(norm)))(
+            *([ids.size for ids in norm] or [0]))
+        flat = (np.concatenate(norm) if norm
+                else np.zeros(0, np.int64))
+        flat = np.ascontiguousarray(flat, np.int64)
+        if flat.size == 0:
+            flat = np.zeros(1, np.int64)  # a valid pointer for ctypes
+        return norm, flat, counts
 
     def pull_sparse_direct(self, ids_list):
-        raise NotImplementedError(
-            "pull_sparse_direct is not ported to the C++ hub: the ONLY "
-            "combination still requiring the Python hub is sparse_tables "
-            "with transport='inproc' and native_ps=True — use "
-            "transport='socket' (the native hub serves the S/V wire "
-            "actions) or drop native_ps")
+        """The S/V exchange minus the frame against the C++ center: one
+        sorted-unique id array per sparse table in, ``(per-leaf values,
+        clock)`` out — full copies for dense leaves, the requested
+        ``[k, dim]`` row blocks for sparse leaves."""
+        if not self.sparse_leaves:
+            raise RuntimeError("pull_sparse_direct on a hub with no sparse "
+                               "tables (pass sparse_leaves to the hub)")
+        norm, flat_ids, counts = self._pack_sparse_ids(ids_list)
+        total = 0
+        it = iter(norm)
+        for i, t in enumerate(self._templates):
+            total += (next(it).size * t.shape[1]
+                      if i in set(self.sparse_leaves) else t.size)
+        out = np.empty(max(1, total), np.float32)
+        clock = int(self._lib.dk_ps_pull_sparse(
+            self._handle,
+            flat_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            counts, _f32p(out)))
+        if clock == -1:
+            raise RuntimeError(
+                "pull_sparse_direct from a never-synced standby refused "
+                "(it holds no job state yet); wait_synced() first")
+        if clock == -2:
+            raise ValueError("sparse pull row ids rejected by the native "
+                             "hub (sorted-unique, in-bounds required)")
+        values, off = [], 0
+        it = iter(norm)
+        for i, t in enumerate(self._templates):
+            if i in set(self.sparse_leaves):
+                k = next(it).size
+                n = k * t.shape[1]
+                values.append(out[off:off + n].reshape(k, t.shape[1]).copy())
+            else:
+                n = t.size
+                values.append(out[off:off + n].reshape(t.shape).copy())
+            off += n
+        return values, clock
 
     def commit_sparse_direct(self, parts, last_pull_clock):
-        raise NotImplementedError(
-            "commit_sparse_direct is not ported to the C++ hub: the ONLY "
-            "combination still requiring the Python hub is sparse_tables "
-            "with transport='inproc' and native_ps=True — use "
-            "transport='socket' (the native hub serves the U/X wire "
-            "actions) or drop native_ps")
+        """Apply one row-sparse commit (the U exchange minus the frame):
+        ``parts`` aligned with the center — full f32 delta for dense
+        leaves, ``(ids, grads)`` for sparse leaves."""
+        if not self.sparse_leaves:
+            raise RuntimeError("commit_sparse_direct on a hub with no "
+                               "sparse tables (pass sparse_leaves)")
+        if len(parts) != len(self._templates):
+            raise ValueError(f"commit has {len(parts)} parts, center has "
+                             f"{len(self._templates)}")
+        sset = set(self.sparse_leaves)
+        ids_list = []
+        vals = []
+        for i, (p, t) in enumerate(zip(parts, self._templates)):
+            if i in sset:
+                ids, grads = p
+                ids = self._check_row_ids(ids, i)
+                grads = np.ascontiguousarray(grads, np.float32).reshape(
+                    ids.size, t.shape[1])
+                ids_list.append(ids)
+                vals.append(grads.reshape(-1))
+            else:
+                vals.append(np.ascontiguousarray(p, np.float32).reshape(-1))
+        counts = (ctypes.c_int64 * max(1, len(ids_list)))(
+            *([ids.size for ids in ids_list] or [0]))
+        flat_ids = (np.concatenate(ids_list) if ids_list
+                    else np.zeros(0, np.int64))
+        flat_ids = np.ascontiguousarray(flat_ids, np.int64)
+        if flat_ids.size == 0:
+            flat_ids = np.zeros(1, np.int64)
+        flat_vals = (np.concatenate(vals) if vals
+                     else np.zeros(0, np.float32))
+        flat_vals = np.ascontiguousarray(flat_vals, np.float32)
+        if flat_vals.size == 0:
+            flat_vals = np.zeros(1, np.float32)
+        ctx = dtrace.current()
+        worker = int(ctx.worker_id) if ctx is not None else -1
+        rc = int(self._lib.dk_ps_commit_sparse(
+            self._handle, _f32p(flat_vals),
+            flat_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            counts, int(last_pull_clock), worker))
+        if rc == 1:
+            raise RuntimeError(
+                "commit_sparse_direct into a never-synced standby refused "
+                "(it has no state to take over); wait_synced() first")
+        if rc == 2:
+            raise net.ProtocolError(
+                "commit into a standby refused (not promoted yet; verifying "
+                "the primary — retry)")
+        if rc == 3:
+            raise ValueError("sparse commit row ids rejected by the native "
+                             "hub (sorted-unique, in-bounds required)")
 
     @property
     def num_updates(self) -> int:

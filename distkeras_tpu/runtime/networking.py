@@ -110,6 +110,24 @@ ACTION_REPL = b"R"
 REPL_DELTA = 0  # primary->replica: blobs[1:] = scaled applied delta
 REPL_SYNC = 1   # primary->replica: blobs[1:] = full center at `clock`
 REPL_HELLO = 2  # replica->primary: no tensor blobs; `clock` = replica's clock
+# sparse row-delta frame (hyperscale embedding tier, ISSUE 15): blobs[1:]
+# carry the applied commit in the U-commit layout — per center leaf in
+# template order, one full f32 delta blob for dense leaves and TWO blobs
+# (int64 row ids, f32 [k, dim] scaled row deltas) for sparse leaves — so
+# replication cost is proportional to the touched rows, not the model.
+# The standby applies ``center[ids] += delta`` behind the same clock
+# fence as a dense delta.  A primary sends these ONLY to replicas whose
+# hello announced REPL_CAP_SPARSE (attach-time capability): a legacy
+# standby keeps receiving the dense-materialized REPL_DELTA stream, so
+# an old-generation standby attached to a new primary is never handed a
+# frame kind it cannot parse
+REPL_SPARSE = 3
+
+# hello capability bits (optional 10th byte of the hello header blob —
+# a 9-byte hello reads as capabilities 0, and a pre-ISSUE-15 primary
+# slices the first 9 bytes off a 10-byte hello, so both directions of
+# version skew degrade to the dense stream instead of a torn one)
+REPL_CAP_SPARSE = 1
 
 # row-sparse embedding traffic (ISSUE 9): a worker whose model declares
 # EmbeddingTable leaves (shape [rows, dim], registered as ``sparse_leaves``
@@ -388,7 +406,11 @@ def _scatter_recv_into(sock: socket.socket, out: Sequence[np.ndarray],
         if nbytes != dst.nbytes or not dst.flags.c_contiguous:
             raise ProtocolError(f"tensor of {nbytes} bytes does not match its "
                              f"output slot ({dst.nbytes} bytes, contiguous)")
-        _recv_exact_into(sock, memoryview(dst).cast("B"))
+        if nbytes:
+            # zero-byte blobs are legal (an all-hit hot-tier pull, an
+            # untouched per-table id set) and an empty ndarray cannot be
+            # cast to a flat memoryview
+            _recv_exact_into(sock, memoryview(dst).cast("B"))
     if obs.enabled():
         obs.counter("net_rx_frames_total").inc()
         obs.counter("net_rx_bytes_total").inc(8 + n)
@@ -518,11 +540,26 @@ def decode_repl_header(blob) -> Tuple[int, int]:
     return int(clock), int(kind)
 
 
-def encode_repl_hello(clock: int) -> bytes:
+def encode_repl_hello(clock: int, capabilities: int = 0) -> bytes:
     """The replica->primary handshake payload: an action-``R`` frame whose
     single blob is the hello header (the replica's current clock rides
-    along for observability; the primary always full-syncs regardless)."""
-    return encode_tensors(ACTION_REPL, [encode_repl_header(clock, REPL_HELLO)])
+    along for observability; the primary always full-syncs regardless).
+    Nonzero ``capabilities`` (:data:`REPL_CAP_SPARSE`) appends a tenth
+    byte announcing what frame kinds this standby can apply — absent
+    (the pre-ISSUE-15 9-byte hello) reads as 0, the dense-only stream."""
+    hdr = encode_repl_header(clock, REPL_HELLO)
+    if capabilities:
+        hdr = np.concatenate(
+            [hdr, np.frombuffer(struct.pack(">B", int(capabilities)),
+                                np.uint8)])
+    return encode_tensors(ACTION_REPL, [hdr])
+
+
+def decode_repl_caps(blob) -> int:
+    """Capability bits of a hello header blob: the optional 10th byte,
+    0 when absent (a 9-byte pre-ISSUE-15 hello = dense-only standby)."""
+    raw = bytes(memoryview(blob))
+    return raw[9] if len(raw) >= 10 else 0
 
 
 def repl_frame_templates(center: Sequence[np.ndarray]) -> List[np.ndarray]:
@@ -712,6 +749,23 @@ class VarFrameEncoder:
             obs.counter("net_tx_frames_total").inc()
             obs.counter("net_tx_bytes_total").inc(self.frame_len)
         return self.frame_len
+
+
+def check_row_ids(ids: np.ndarray, rows: int, leaf: int) -> np.ndarray:
+    """Validate one table's canonical wire row-id array: in-bounds,
+    strictly ascending (sorted AND unique — what makes the fancy-indexed
+    ``center[ids] += grads`` apply exact).  The ONE validation contract
+    both hub implementations enforce — peers present canonical ids, the
+    hub REJECTS rather than repairs (repairing would hide a desynced
+    caller).  Returns ``ids`` unchanged (callers pass zero-copy views)."""
+    if ids.size:
+        if ids[0] < 0 or ids[-1] >= rows:
+            raise ValueError(f"sparse leaf {leaf}: row ids outside "
+                             f"[0, {rows})")
+        if ids.size > 1 and not (np.diff(ids) > 0).all():
+            raise ValueError(f"sparse leaf {leaf}: row ids must be "
+                             f"sorted and unique")
+    return ids
 
 
 def normalize_row_ids(ids, rows: int) -> np.ndarray:

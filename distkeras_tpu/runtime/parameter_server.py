@@ -187,14 +187,25 @@ class ReplicationFeed:
     def __init__(self, hub: "SocketParameterServer"):
         self.hub = hub
         self._lock = threading.Lock()  # serializes attach + publish
-        # [socket, conn ordinal, attach-time SYNC clock] per replica.  The
-        # sync clock is IMMUTABLE after attach: it only filters deltas the
-        # full sync already covered.  It must never advance on sends —
-        # concurrent handlers publish out of clock order (apply under the
-        # hub lock, publish under this one), and a moving watermark would
-        # skip (lose) the lower-clock delta behind a higher one
+        # [socket, conn ordinal, attach-time SYNC clock, sparse-capable]
+        # per replica.  The sync clock is IMMUTABLE after attach: it only
+        # filters deltas the full sync already covered.  It must never
+        # advance on sends — concurrent handlers publish out of clock
+        # order (apply under the hub lock, publish under this one), and a
+        # moving watermark would skip (lose) the lower-clock delta behind
+        # a higher one.  The capability flag is likewise attach-time
+        # immutable (the hello announced it): a sparse commit streams as
+        # one REPL_SPARSE row-delta frame to capable replicas and as the
+        # dense-materialized REPL_DELTA to legacy ones — never a frame
+        # kind the peer cannot parse
         self._conns: List[List[Any]] = []
         self._codec = net.FlatFrameCodec(net.repl_frame_templates(hub.center))
+        # sparse row-delta frames vary per commit (row blobs sized by the
+        # touched set), so they ride a grow-once variable encoder
+        self._sp_enc = net.VarFrameEncoder()
+        # cumulative row-delta bytes actually published (the `RΔ` series
+        # distkeras-top renders from the hub pseudo-worker's metrics)
+        self.repl_sparse_bytes = 0
 
     def active(self) -> bool:
         # racy read by design (publish re-checks under the lock): the
@@ -206,7 +217,8 @@ class ReplicationFeed:
             obs.gauge("ps_replicas_connected",
                       **self.hub._mlabels).set(len(self._conns))
 
-    def attach(self, conn: socket.socket, conn_idx: int) -> None:
+    def attach(self, conn: socket.socket, conn_idx: int,
+               capabilities: int = 0) -> None:
         """Handshake a replica connection: full-sync it (center + clock,
         captured under the hub lock) and register it for the delta
         stream.  Registration happens BEFORE the center snapshot: a commit
@@ -214,10 +226,14 @@ class ReplicationFeed:
         (blocking on this lock until the sync is out, then skipped iff the
         sync already covered it), while a commit applying before it is in
         the snapshot — snapshotting first instead would let a commit slip
-        into the gap unpublished AND unsynced."""
+        into the gap unpublished AND unsynced.  ``capabilities`` is the
+        hello's attach-time announcement (:data:`networking.
+        REPL_CAP_SPARSE`): it decides the frame kinds this replica is
+        ever sent."""
         conn.settimeout(self.REPLICA_SEND_TIMEOUT)
+        sparse_ok = bool(capabilities & net.REPL_CAP_SPARSE)
         with self._lock:
-            entry: List[Any] = [conn, conn_idx, -1]
+            entry: List[Any] = [conn, conn_idx, -1, sparse_ok]
             self._conns.append(entry)
             try:
                 with self.hub._lock:
@@ -240,33 +256,109 @@ class ReplicationFeed:
                         **self.hub._mlabels).inc()
             self._set_gauge()
 
-    def publish(self, clock: int, scaled: Sequence[np.ndarray]) -> None:
+    def _densify(self, scaled: Sequence[Any]) -> List[np.ndarray]:
+        """Center-shaped materialization of a (possibly row-sparse) scaled
+        commit — the dense-``R`` fallback frame a legacy replica applies.
+        Scattering ``full[ids] = g`` makes the standby's ``center +=
+        full`` perform the touched rows' float additions exactly as the
+        primary's ``center[ids] += g`` did (idle rows add 0.0)."""
+        out: List[np.ndarray] = []
+        for c, p in zip(self.hub.center, scaled):
+            if isinstance(p, tuple):
+                ids, g = p
+                full = np.zeros_like(c)
+                if ids.size:
+                    full[ids] = g
+                out.append(full)
+            else:
+                out.append(np.asarray(p, np.float32))
+        return out
+
+    def _sparse_blobs(self, header: np.ndarray,
+                      scaled: Sequence[Any]) -> List[np.ndarray]:
+        """Blob list of one REPL_SPARSE frame: header + the U-commit
+        layout (dense leaves whole, sparse leaves as (ids, rows))."""
+        blobs: List[np.ndarray] = [header]
+        for p in scaled:
+            if isinstance(p, tuple):
+                blobs.append(np.ascontiguousarray(p[0], net.ROW_ID_DTYPE))
+                blobs.append(np.ascontiguousarray(p[1], np.float32))
+            else:
+                blobs.append(np.ascontiguousarray(p, np.float32))
+        return blobs
+
+    def publish(self, clock: int, scaled: Sequence[Any]) -> None:
         """Stream one applied commit to every attached replica; returns
         once the frame is written (kernel-owned) everywhere — the caller
-        acks its worker only after."""
+        acks its worker only after.  ``scaled`` is per-leaf parts aligned
+        with the center: full arrays for dense leaves, ``(ids, scaled row
+        deltas)`` tuples for row-sparse leaves of a sparse commit.  Row-
+        sparse parts stream as ONE REPL_SPARSE frame to sparse-capable
+        replicas (cost ∝ touched rows) and are densified — outside the
+        center lock, only when a legacy replica is actually attached —
+        into the pre-ISSUE-15 REPL_DELTA frame for the rest."""
         telemetry = obs.enabled()
         t0 = time.perf_counter() if telemetry else 0.0
+        has_rows = any(isinstance(p, tuple) for p in scaled)
+        sp_sent = 0
+        sp_frame_len = 0
         with self._lock:
             if not self._conns:
                 return
             packed = False
+            sp_frame: Optional[memoryview] = None
             dead = []
             for entry in self._conns:
-                conn, conn_idx, sync_clock = entry
+                conn, conn_idx, sync_clock, sparse_ok = entry
                 if sync_clock >= clock:
                     continue  # already covered by this replica's full sync
-                if not packed:
-                    self._codec.pack(
-                        net.ACTION_REPL,
-                        [net.encode_repl_header(clock, net.REPL_DELTA)]
-                        + list(scaled))
-                    packed = True
                 try:
-                    self._codec.send_packed(conn)  # lint: blocking-ok send-before-ack IS the zero-loss replication contract; stall bounded by REPLICA_SEND_TIMEOUT, then detach
+                    if has_rows and sparse_ok:
+                        if sp_frame is None:
+                            sp_frame = self._sp_enc.pack(
+                                net.ACTION_REPL, self._sparse_blobs(
+                                    net.encode_repl_header(
+                                        clock, net.REPL_SPARSE), scaled))
+                        conn.sendall(sp_frame)  # lint: blocking-ok send-before-ack IS the zero-loss replication contract; stall bounded by REPLICA_SEND_TIMEOUT, then detach
+                        sp_sent += 1
+                        if telemetry:
+                            obs.counter("net_tx_frames_total").inc()
+                            obs.counter("net_tx_bytes_total").inc(
+                                self._sp_enc.frame_len)
+                    else:
+                        if not packed:
+                            self._codec.pack(
+                                net.ACTION_REPL,
+                                [net.encode_repl_header(clock,
+                                                        net.REPL_DELTA)]
+                                + (self._densify(scaled) if has_rows
+                                   else list(scaled)))
+                            packed = True
+                        self._codec.send_packed(conn)  # lint: blocking-ok send-before-ack IS the zero-loss replication contract; stall bounded by REPLICA_SEND_TIMEOUT, then detach
                 except (OSError, ValueError) as e:
                     dead.append((entry, e))
             for entry, e in dead:
                 self._detach_locked(entry, e)
+            if sp_sent:
+                # counted (and frame_len snapshotted) under the feed lock:
+                # a concurrent publish repacks the shared encoder the
+                # moment we release it
+                sp_frame_len = self._sp_enc.frame_len
+                self.repl_sparse_bytes += sp_sent * sp_frame_len
+                repl_sparse_total = self.repl_sparse_bytes
+        if sp_sent and telemetry:
+            # bytes the row-delta framing saved vs the dense-R frame each
+            # capable replica would otherwise have been sent
+            obs.counter("ps.repl_sparse_bytes_saved",
+                        **self.hub._mlabels).inc(
+                sp_sent * max(0, self._codec.frame_len - sp_frame_len))
+        if sp_sent:
+            # the live collector's cumulative RΔ series (rate = bytes/s
+            # in distkeras-top), under the hub pseudo-worker key like
+            # replication_lag below
+            self.hub._observe_health(
+                f"hub{'' if self.hub.shard_id is None else self.hub.shard_id}",
+                "repl_sparse_bytes_total", repl_sparse_total, any_shard=True)
         # commits the hub applied while this publish waited its turn:
         # the feed's real-time backlog (clock reads race commits by
         # design — it is a gauge, not an invariant)
@@ -287,7 +379,7 @@ class ReplicationFeed:
             "replication_lag", lag, any_shard=True)
 
     def _detach_locked(self, entry: List[Any], cause: BaseException) -> None:
-        conn, conn_idx, _ = entry
+        conn, conn_idx = entry[0], entry[1]
         self._conns.remove(entry)
         warnings.warn(f"replica connection {conn_idx} dropped from the "
                       f"replication feed: {type(cause).__name__}: {cause}")
@@ -644,6 +736,10 @@ class _AdaptiveCombiner:
                 entry["batch"] = len(batch)
                 scaled_all.append(
                     _scale_parts(entry["parts"], np.float32(scale)))
+                if telemetry:
+                    hub._touch_rows_locked(
+                        (i, p[0]) for i, p in enumerate(entry["parts"])
+                        if isinstance(p, tuple))
             if len(scaled_all) > 1 and not _mixed_repr(scaled_all):
                 applied = [adasum_merge(scaled_all)]
             else:
@@ -651,30 +747,31 @@ class _AdaptiveCombiner:
                 # applied sequentially (plain queue-order semantics):
                 # merging it would densify sparse sides under this lock
                 applied = scaled_all
-            if replicate:
-                # replica contract: ONE center-shaped delta per batch
-                # (owned storage — _scale_parts' multiply owns), applied
-                # exactly as published, so primary and replica perform
-                # IDENTICAL float additions (bit-for-bit)
-                if len(applied) == 1 and not any(
-                        isinstance(p, tuple) for p in applied[0]):
-                    # the dominant case (uncontended all-dense commit):
-                    # the scaled copy already IS the center-shaped delta
-                    dense = applied[0]
-                else:
-                    dense = [np.zeros_like(c) for c in hub.center]
-                    for parts in applied:
-                        for full, p in zip(dense, parts):
-                            if isinstance(p, tuple):
-                                ids, g = p
-                                if ids.size:
-                                    full[ids] += g
-                            else:
-                                full += p
+            if replicate and len(applied) > 1:
+                # the RARE sequential (mixed dense/sparse) batch keeps the
+                # pre-ISSUE-15 replica contract: ONE center-shaped delta
+                # for the whole batch, applied exactly as published, so
+                # primary and replica perform IDENTICAL float additions
+                dense = [np.zeros_like(c) for c in hub.center]
+                for parts in applied:
+                    for full, p in zip(dense, parts):
+                        if isinstance(p, tuple):
+                            ids, g = p
+                            if ids.size:
+                                full[ids] += g
+                        else:
+                            full += p
                 for c, full in zip(hub.center, dense):
                     c += full
+                publish_parts = dense
             else:
-                dense = None
+                # ONE commit (uncontended, or the whole batch Adasum-
+                # merged): apply in its native representation — sparse
+                # leaves touch only their merged ROW UNION — and hand the
+                # same parts to the feed, which frames them sparse for
+                # capable replicas (cost ∝ touched rows) and densifies
+                # only for legacy ones (_scale_parts/adasum own storage)
+                publish_parts = applied[0] if replicate else None
                 for parts in applied:
                     for c, p in zip(hub.center, parts):
                         if isinstance(p, tuple):
@@ -687,7 +784,7 @@ class _AdaptiveCombiner:
             hub._clock += len(batch)
             commit_clock = hub._clock
         if replicate:
-            feed.publish(commit_clock, dense)
+            feed.publish(commit_clock, publish_parts)
         size = len(batch)
         self.batches_total += 1
         if size > self.max_batch:
@@ -816,6 +913,20 @@ class SocketParameterServer:
                     f"sparse leaf {i} must be a [rows, dim] table, got "
                     f"shape {self.center[i].shape}")
         self._sparse_set = frozenset(self.sparse_leaves)
+        # hyperscale row-touch telemetry (ISSUE 15): one exponentially-
+        # decayed per-row touch counter array per sparse table, folded on
+        # every sparse pull/commit UNDER the center lock (the ids are
+        # already validated there) while telemetry is on.  Every
+        # TOUCH_DECAY_EVERY folds the counters halve; the count of rows
+        # still at or above TOUCH_HOT_MIN is then a decayed estimate of
+        # the live hot set — the ``ps.sparse_hot_rows{table=}`` gauge an
+        # operator sizes ``sparse_cache_rows`` from.  Cost when off: one
+        # enabled() check per sparse request; memory: 4 bytes/row/table
+        # (dim/4 of the table the hub already holds)
+        self._sparse_touch: Dict[int, np.ndarray] = {
+            i: np.zeros(self.center[i].shape[0], np.float32)
+            for i in self.sparse_leaves}
+        self._touch_folds = 0
         # full flat-frame size of a pull reply / f32 commit (header, action,
         # count, per-tensor prefixes, payload) — the socket-buffer hint.
         # A shard hub computes this from ITS center subset, so per-shard
@@ -1153,6 +1264,73 @@ class SocketParameterServer:
             pass
         return True
 
+    def _apply_repl_frame(self, clock: int, kind: int, blobs) -> None:
+        """Apply one replication frame of ANY kind under the center lock —
+        the sparse-capable standby's receive leg.  ``blobs`` are the
+        frame's tensor blobs past the header (views into the feed's
+        receive buffer, consumed before the next frame lands).  A
+        REPL_SPARSE frame carries the U-commit layout: full f32 delta
+        blobs for dense leaves, ``(ids, scaled rows)`` blob pairs for
+        sparse leaves — applied ``center[ids] += rows`` behind the same
+        clock fence semantics as a dense delta.  Malformed layouts raise
+        ``ProtocolError`` (feed loss; the loop reconnects/promotes under
+        its budget)."""
+        with self._lock:
+            if self.promoted:
+                return  # late frame post-promotion: never lands
+            if kind in (net.REPL_SYNC, net.REPL_DELTA):
+                if len(blobs) != len(self.center):
+                    raise net.ProtocolError(
+                        f"replication frame has {len(blobs)} blobs, center "
+                        f"has {len(self.center)}")
+                for c, b in zip(self.center, blobs):
+                    arr = np.frombuffer(b, np.float32)
+                    if arr.size != c.size:
+                        raise net.ProtocolError(
+                            f"replication blob of {arr.size} values does "
+                            f"not match its leaf ({c.size})")
+                    if kind == net.REPL_SYNC:
+                        c[...] = arr.reshape(c.shape)
+                    else:
+                        c += arr.reshape(c.shape)
+                if kind == net.REPL_SYNC:
+                    self._clock = clock
+                    self.num_updates = clock
+                    self._synced.set()
+                else:
+                    self._clock = max(self._clock, clock)
+                    self.num_updates += 1
+            elif kind == net.REPL_SPARSE:
+                expected = len(self.center) + len(self.sparse_leaves)
+                if len(blobs) != expected:
+                    raise net.ProtocolError(
+                        f"sparse replication frame has {len(blobs)} blobs, "
+                        f"expected {expected}")
+                it = iter(blobs)
+                for i, c in enumerate(self.center):
+                    if i in self._sparse_set:
+                        ids = self._check_row_ids(
+                            np.frombuffer(next(it), net.ROW_ID_DTYPE), i)
+                        rows = np.frombuffer(next(it), np.float32)
+                        if rows.size != ids.size * c.shape[1]:
+                            raise net.ProtocolError(
+                                f"sparse replication leaf {i}: {rows.size} "
+                                f"values for {ids.size} rows of dim "
+                                f"{c.shape[1]}")
+                        if ids.size:
+                            c[ids] += rows.reshape(ids.size, c.shape[1])
+                    else:
+                        arr = np.frombuffer(next(it), np.float32)
+                        if arr.size != c.size:
+                            raise net.ProtocolError(
+                                f"replication blob of {arr.size} values "
+                                f"does not match its leaf ({c.size})")
+                        c += arr.reshape(c.shape)
+                self._clock = max(self._clock, clock)
+                self.num_updates += 1
+            else:
+                raise net.ProtocolError(f"unknown replication kind {kind}")
+
     def _replica_loop(self) -> None:
         """Track the primary: connect, handshake (action R hello), apply the
         full sync then every streamed delta under the center lock.  On feed
@@ -1164,6 +1342,19 @@ class SocketParameterServer:
         codec = net.FlatFrameCodec(net.repl_frame_templates(self.center))
         hdr = np.empty(9, np.uint8)
         bufs = [np.empty(c.shape, np.float32) for c in self.center]
+        # a sparse-capable standby (this hub serves row-sparse tables)
+        # announces REPL_CAP_SPARSE and receives through the generic
+        # variable-frame path: the stream then mixes fixed-size
+        # SYNC/DELTA frames with row-delta REPL_SPARSE frames whose blob
+        # sizes vary per commit.  A dense hub keeps the pre-ISSUE-15
+        # fixed-codec loop byte for byte
+        sparse_feed = bool(self.sparse_leaves)
+        caps = net.REPL_CAP_SPARSE if sparse_feed else 0
+        # largest valid feed payload: a full sync frame plus, for sparse
+        # frames, one worst-case id blob per table
+        feed_limit = codec.payload_len + sum(
+            8 + 8 * self.center[i].shape[0] for i in self.sparse_leaves)
+        rx = bytearray(4096) if sparse_feed else None
         failures = 0
         warned_unsynced = False
         while not self._replica_stop.is_set():
@@ -1197,8 +1388,28 @@ class SocketParameterServer:
             if sock is not None:
                 self._replica_sock = sock
                 try:
-                    net.send_frame(sock, net.encode_repl_hello(self._clock))
+                    net.send_frame(sock, net.encode_repl_hello(
+                        self._clock, capabilities=caps))
                     while not self._replica_stop.is_set():
+                        if sparse_feed:
+                            payload = net.recv_frame_into(sock, rx,
+                                                          limit=feed_limit)
+                            action, blobs = net.decode_tensor_views(payload)
+                            if action != net.ACTION_REPL:
+                                raise net.ProtocolError(
+                                    f"replica feed expected R, got "
+                                    f"{action!r}")
+                            clock, kind = net.decode_repl_header(blobs[0])
+                            self._apply_repl_frame(clock, kind, blobs[1:])
+                            if self.promoted:
+                                return  # late frame post-promotion
+                            failures = 0
+                            if obs.enabled():
+                                obs.counter("ps_replica_frames_total",
+                                            **self._mlabels).inc()
+                                obs.gauge("ps_replica_clock",
+                                          **self._mlabels).set(clock)
+                            continue
                         action = codec.recv_into(sock, [hdr] + bufs)
                         if action != net.ACTION_REPL:
                             raise net.ProtocolError(
@@ -1533,6 +1744,29 @@ class SocketParameterServer:
                 for blob, c in zip(blobs, self.center)]
 
     # -- row-sparse embedding traffic (ISSUE 9) --------------------------------
+    # decay cadence of the hot-set estimate: halve every N folds, count
+    # rows still >= TOUCH_HOT_MIN.  Instance-tunable (tests retune)
+    TOUCH_DECAY_EVERY = 64
+    TOUCH_HOT_MIN = 1.0
+
+    def _touch_rows_locked(self, pairs) -> None:
+        """Fold touched rows into the decayed per-table counters (caller
+        holds the center lock and checked ``obs.enabled()``).  ``pairs``
+        yields ``(leaf, ids)``; on each decay tick the
+        ``ps.sparse_hot_rows{table=}`` gauges refresh."""
+        for leaf, ids in pairs:
+            touch = self._sparse_touch.get(leaf)
+            if touch is not None and ids.size:
+                touch[ids] += np.float32(1.0)
+        self._touch_folds += 1
+        if self._touch_folds >= self.TOUCH_DECAY_EVERY:
+            self._touch_folds = 0
+            for leaf, touch in self._sparse_touch.items():
+                touch *= np.float32(0.5)
+                obs.gauge("ps.sparse_hot_rows", table=str(leaf),
+                          **self._mlabels).set(
+                    int(np.count_nonzero(touch >= self.TOUCH_HOT_MIN)))
+
     def _q_payload_bytes(self) -> int:
         """Payload bytes of a DENSE int8 (action Q) commit over this
         center — the like-for-like baseline ``ps.sparse_wire_bytes_saved``
@@ -1540,18 +1774,10 @@ class SocketParameterServer:
         return 5 + sum(8 + 4 + w.size for w in self.center)
 
     def _check_row_ids(self, ids: np.ndarray, leaf: int) -> np.ndarray:
-        """Validate one table's wire row-id blob: int64, in-bounds,
-        strictly ascending (sorted AND unique — what makes the
-        fancy-indexed ``center[ids] += grads`` apply exact)."""
-        rows = self.center[leaf].shape[0]
-        if ids.size:
-            if ids[0] < 0 or ids[-1] >= rows:
-                raise ValueError(f"sparse leaf {leaf}: row ids outside "
-                                 f"[0, {rows})")
-            if ids.size > 1 and not (np.diff(ids) > 0).all():
-                raise ValueError(f"sparse leaf {leaf}: row ids must be "
-                                 f"sorted and unique")
-        return ids
+        """Validate one table's wire row-id blob against this center's
+        row count (the shared :func:`networking.check_row_ids`
+        contract)."""
+        return net.check_row_ids(ids, self.center[leaf].shape[0], leaf)
 
     def _decode_sparse_ids(self, blobs) -> List[np.ndarray]:
         """Action-``S`` request payload -> one validated id array per
@@ -1610,35 +1836,40 @@ class SocketParameterServer:
         ``center[ids] += commit_scale(staleness) * grads`` — under the
         SAME staleness clock and scaling rule the dense paths and the
         replication feed already share.  When a replica is attached the
-        full scaled delta is materialized (idle rows as zeros) so the
-        existing center-shaped R codec carries the applied row deltas
-        unchanged; returns it for the feed, else None."""
+        applied scaled parts are returned for the feed IN ROW-SPARSE FORM
+        (``(ids, scaled rows)`` tuples; owned copies): the feed streams
+        them as one REPL_SPARSE row-delta frame to sparse-capable
+        replicas and densifies — outside this lock, only if a legacy
+        replica is attached — for the dense-``R`` fallback.  Returns
+        None with no replica (the pre-HA in-place path)."""
         feed = self._feed
         replicate = feed is not None and feed.active()
         scale = np.float32(self.commit_scale(staleness))
         one = scale == np.float32(1.0)
-        scaled: Optional[List[np.ndarray]] = [] if replicate else None
+        scaled: Optional[List[Any]] = [] if replicate else None
         for c, p in zip(self.center, parts):
             if isinstance(p, tuple):
                 ids, grads = p
                 g = grads if one else grads * scale
                 if replicate:
-                    full = np.zeros_like(c)
-                    if ids.size:
-                        full[ids] = g
-                    scaled.append(full)
+                    # OWNED copies for the feed (wire ids/grads are views
+                    # into the receive buffer) — `* scale` already owns
+                    # except on the scale-1 fast path
+                    scaled.append((np.array(ids, net.ROW_ID_DTYPE),
+                                   np.array(g, np.float32) if one else g))
                 if ids.size:
                     c[ids] += g
             else:
                 arr = np.asarray(p, np.float32)
                 g = arr if one else arr * scale
                 if replicate:
-                    # an OWNED copy for the feed (wire deltas are views
-                    # into the receive buffer) — `* scale` above already
-                    # owns except on the scale-1 fast path
                     g = np.array(g, np.float32) if one else g
                     scaled.append(g)
                 c += g
+        if obs.enabled():
+            self._touch_rows_locked(
+                (i, p[0]) for i, p in enumerate(parts)
+                if isinstance(p, tuple))
         return scaled
 
     def _handle_connection(self, conn: socket.socket, conn_idx: int = 0) -> None:
@@ -1819,6 +2050,9 @@ class SocketParameterServer:
                             frame = sp_enc.pack(net.ACTION_SPARSE_WEIGHTS,
                                                 arrays)
                             last_pull_clock = self._clock
+                            if telemetry:
+                                self._touch_rows_locked(
+                                    zip(self.sparse_leaves, ids_list))
                         net.send_raw_frame(conn, frame)
                     if telemetry:
                         obs.counter("ps_pulls_total", **self._mlabels).inc()
@@ -1925,7 +2159,9 @@ class SocketParameterServer:
                     with obs.span("ps.replica_attach", conn=conn_idx,
                                   replica_clock=clock_hdr,
                                   **self._shard_attrs):
-                        feed.attach(conn, conn_idx)
+                        feed.attach(conn, conn_idx,
+                                    capabilities=net.decode_repl_caps(
+                                        blobs[0]))
                     handoff = True
                     return
                 elif action == net.ACTION_HEALTH:
@@ -2094,6 +2330,9 @@ class SocketParameterServer:
                     else self.center[i].copy()
                     for i in range(len(self.center))]
                 clock = self._clock
+                if telemetry:
+                    self._touch_rows_locked(
+                        zip(self.sparse_leaves, ids_list))
         if telemetry:
             obs.counter("ps_pulls_total", **self._mlabels).inc()
             obs.counter("ps.sparse_rows_pulled",
@@ -2387,10 +2626,313 @@ def _sparse_parts_from_arrays(arrays: Sequence[np.ndarray],
     return parts
 
 
+def _init_hot_tier(client: Any, sparse_cache_rows: Optional[int],
+                   compress: Optional[str]) -> None:
+    """Shared hot-tier state constructor (PSClient + InprocPSClient):
+    validates ``sparse_cache_rows``, builds either the PR-9 full-size
+    per-table caches (``None``) or one bounded :class:`_RowLRU` per
+    table, and the evict-forces-flush overflow.  Requires
+    ``client.templates`` / ``client._sparse`` to be set."""
+    client._cache_rows = (None if sparse_cache_rows is None
+                          else int(sparse_cache_rows))
+    if client._cache_rows is not None:
+        if not client._sparse:
+            raise ValueError("sparse_cache_rows needs sparse_leaves")
+        if client._cache_rows < 1:
+            raise ValueError(f"sparse_cache_rows must be >= 1, got "
+                             f"{client._cache_rows}")
+    if client._cache_rows is None:
+        client._cache = {i: np.array(client.templates[i], np.float32)
+                         for i in client._sparse}
+        client._lru = {}
+    else:
+        client._cache = {}
+        client._lru = {
+            i: _RowLRU(min(client._cache_rows,
+                           client.templates[i].shape[0]),
+                       client.templates[i].shape[1],
+                       residual=(compress == "int8"))
+            for i in client._sparse}
+    # evict-forces-flush overflow (int8 cache mode): leaf -> {row id ->
+    # pending residual row} accumulated at eviction, flushed as extra
+    # (ids, residual) rows on the next sparse commit of that leaf —
+    # eviction never LOSES a pending residual
+    client._flush_pending = {i: {} for i in client._sparse}
+
+
+def _hot_tier_gather(client: Any, ids_list: Sequence[np.ndarray]
+                     ) -> Tuple[List[np.ndarray], List[np.ndarray],
+                                List[np.ndarray]]:
+    """Resolve one pull's ids against the LRUs NOW (hit values copied
+    into fresh result blocks at this instant); returns
+    ``(blocks, miss_positions, miss_ids)`` per table.  Counts the hits
+    into the registry."""
+    hits0 = client.sparse_cache_hits
+    blocks: List[np.ndarray] = []
+    miss_pos: List[np.ndarray] = []
+    miss: List[np.ndarray] = []
+    for ids, i in zip(ids_list, client._sparse):
+        block = np.empty((ids.size, client.templates[i].shape[1]),
+                         np.float32)
+        mp, miss_ids = client._lru[i].gather(ids, block)
+        blocks.append(block)
+        miss_pos.append(mp)
+        miss.append(miss_ids)
+    if obs.enabled() and client.sparse_cache_hits > hits0:
+        obs.counter("ps_sparse_cache_hits_total",
+                    **getattr(client, "_mlabels", {})).inc(
+            client.sparse_cache_hits - hits0)
+    return blocks, miss_pos, miss
+
+
+def _hot_tier_file_misses(client: Any, leaf: int, miss_ids: np.ndarray,
+                          rows: np.ndarray) -> None:
+    """File one table's freshly-pulled miss rows into its LRU,
+    accumulating evicted rows' pending int8 residuals into the flush
+    overflow (the evict-forces-flush rule)."""
+    for rid, res_row in client._lru[leaf].insert(miss_ids, rows):
+        pend = client._flush_pending[leaf]
+        if rid in pend:
+            pend[rid] += res_row
+        else:
+            pend[rid] = res_row
+
+
+def _count_cache_misses(client: Any, misses0: int) -> None:
+    if obs.enabled() and client.sparse_cache_misses > misses0:
+        obs.counter("ps_sparse_cache_misses_total",
+                    **getattr(client, "_mlabels", {})).inc(
+            client.sparse_cache_misses - misses0)
+
+
+def _hot_tier_seed(client: Any, leaf: int, full: np.ndarray) -> None:
+    """A full pull's table values refresh every RESIDENT row and, on
+    first contact, seed the LRU with the table's lowest ids (CTR
+    vocabularies conventionally place frequent ids low; a wrong guess
+    only costs misses)."""
+    lru = client._lru[leaf]
+    full = np.asarray(full, np.float32)
+    if not lru.slots:
+        seed = np.arange(lru.cap, dtype=net.ROW_ID_DTYPE)
+        lru.insert(seed, full[:lru.cap])
+        lru.misses -= lru.cap  # seeding is not demand misses
+    else:
+        for rid, slot in lru.slots.items():
+            lru.vals[slot] = full[rid]
+
+
+def _hot_tier_commit_arrays(client: Any, delta: Sequence[np.ndarray],
+                            ids_list: Sequence[np.ndarray]
+                            ) -> List[np.ndarray]:
+    """The ONE hot-tier commit implementation both transports share (the
+    ``_sparse_commit_arrays`` convention extended to the bounded LRU):
+    ``client`` is a PSClient/InprocPSClient in cache mode — its per-leaf
+    LRUs supply residual state, evicted-residual flushes join the id set,
+    and the post-wire rows merge into resident entries in place."""
+    arrays: List[np.ndarray] = []
+    it = iter(ids_list)
+    for i, d in enumerate(delta):
+        if i not in client._sparse_set:
+            if client.compress == "int8":
+                carried = np.asarray(d, np.float32) + client._residual[i]
+                blob, client._residual[i] = net.quantize_q_blob(carried)
+                arrays.append(np.frombuffer(blob, np.uint8))
+            else:
+                arrays.append(np.asarray(d, np.float32))
+            continue
+        ids = next(it)
+        lru = client._lru[i]
+        dim = client.templates[i].shape[1]
+        pend = client._flush_pending[i]
+        if pend:
+            ids_all = np.union1d(
+                ids, np.fromiter(pend.keys(), np.int64, len(pend)))
+        else:
+            ids_all = ids
+        rows = np.ascontiguousarray(np.asarray(d, np.float32)[ids_all])
+        if client.compress == "int8":
+            carried = rows + lru.residual_rows(ids_all)
+            if pend:
+                for pos, rid in enumerate(ids_all):
+                    r = pend.pop(int(rid), None)
+                    if r is not None:
+                        carried[pos] += r
+            blob, res = net.quantize_q_blob(carried)
+            lru.store_residuals(ids_all, res)
+            wire_rows = net.dequantize_q_blob(
+                blob, ids_all.size * dim).reshape(ids_all.size, dim)
+            arrays.append(ids_all)
+            arrays.append(np.frombuffer(blob, np.uint8))
+        else:
+            wire_rows = rows
+            arrays.append(ids_all)
+            arrays.append(rows)
+        lru.merge(ids_all, wire_rows)
+    return arrays
+
+
+class _RowLRU:
+    """Bounded host store for ONE sparse table's hot rows (the hyperscale
+    client tier, ISSUE 15): ``cap`` value rows (+ int8 residual rows when
+    error feedback is on) keyed by row id, least-recently-used eviction.
+
+    This replaces the full-size per-table host cache AND residual slab of
+    the PR-9 client — host memory per table drops from ``rows x dim x 4``
+    (x2 under int8) to ``cap x dim x 4`` (x2), so a client serving a
+    hundred-GB vocabulary holds only its hot tier.  Semantics:
+
+    - ``gather`` resolves a pull's ids against the store: hit rows are
+      copied out IMMEDIATELY (so later merges/evictions can never tear a
+      pull that was already resolved) and only the misses go to the wire;
+    - ``insert`` files a miss reply's fresh rows, evicting LRU victims;
+      an evicted row's pending int8 residual is RETURNED to the caller
+      (the evict-forces-flush rule — it piggybacks on the next commit,
+      never silently dropped);
+    - ``merge`` folds the client's OWN committed rows into resident
+      entries in place (hits merge in place), keeping a hit's value
+      exact under scale-1 hubs and within the async staleness tolerance
+      otherwise (other workers' updates arrive when the row next
+      misses).
+
+    Not thread-safe: owned by the client's caller thread like every other
+    pipeline structure."""
+
+    def __init__(self, cap: int, dim: int, residual: bool):
+        self.cap = max(1, int(cap))
+        self.dim = int(dim)
+        self.vals = np.zeros((self.cap, self.dim), np.float32)
+        self.res = (np.zeros((self.cap, self.dim), np.float32)
+                    if residual else None)
+        # id -> slot; Python dicts preserve insertion order, so re-inserting
+        # on touch makes the FIRST key the LRU victim (an OrderedDict
+        # without the import)
+        self.slots: Dict[int, int] = {}
+        self._free = list(range(self.cap - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def nbytes(self) -> int:
+        return self.vals.nbytes + (self.res.nbytes if self.res is not None
+                                   else 0)
+
+    def _touch(self, rid: int, slot: int) -> None:
+        del self.slots[rid]
+        self.slots[rid] = slot
+
+    def gather(self, ids: np.ndarray, out: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Resolve ``ids`` (sorted unique) against the store: hit rows are
+        copied into their positions of ``out`` ([k, dim], the pull's
+        result block) now; returns ``(miss_positions, miss_ids)`` — the
+        rows the wire must fetch."""
+        miss_pos: List[int] = []
+        for pos, rid in enumerate(ids):
+            slot = self.slots.get(int(rid))
+            if slot is None:
+                miss_pos.append(pos)
+            else:
+                out[pos] = self.vals[slot]
+                self._touch(int(rid), slot)
+                self.hits += 1
+        mp = np.asarray(miss_pos, np.int64)
+        return mp, ids[mp]
+
+    def insert(self, ids: np.ndarray, rows: np.ndarray
+               ) -> List[Tuple[int, np.ndarray]]:
+        """File freshly-pulled rows (misses, or a seeding pass); returns
+        ``[(evicted id, pending residual row)]`` for victims whose int8
+        residual was nonzero (the evict-forces-flush payload)."""
+        flushed: List[Tuple[int, np.ndarray]] = []
+        for pos, rid in enumerate(ids):
+            rid = int(rid)
+            slot = self.slots.get(rid)
+            if slot is not None:
+                self.vals[slot] = rows[pos]
+                self._touch(rid, slot)
+                continue
+            self.misses += 1
+            if self._free:
+                slot = self._free.pop()
+            else:
+                victim, slot = next(iter(self.slots.items()))
+                del self.slots[victim]
+                self.evictions += 1
+                if self.res is not None and self.res[slot].any():
+                    flushed.append((victim, self.res[slot].copy()))
+            self.vals[slot] = rows[pos]
+            if self.res is not None:
+                self.res[slot] = 0.0
+            self.slots[rid] = slot
+        return flushed
+
+    def merge(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Fold the client's own committed (post-wire) rows into resident
+        entries in place; absent rows are skipped (they re-pull fresh on
+        their next miss)."""
+        for pos, rid in enumerate(ids):
+            slot = self.slots.get(int(rid))
+            if slot is not None:
+                self.vals[slot] += rows[pos]
+
+    def residual_rows(self, ids: np.ndarray) -> np.ndarray:
+        """[k, dim] residual block for ``ids``: resident rows read their
+        slot, absent rows read zero (their pending residual, if any, was
+        already flushed at eviction)."""
+        out = np.zeros((len(ids), self.dim), np.float32)
+        if self.res is not None:
+            for pos, rid in enumerate(ids):
+                slot = self.slots.get(int(rid))
+                if slot is not None:
+                    out[pos] = self.res[slot]
+        return out
+
+    def store_residuals(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Write back post-quantization residual rows for resident ids;
+        a non-resident id's fresh rounding error (at most one quantization
+        step of this block) is dropped — the documented flush tail."""
+        if self.res is None:
+            return
+        for pos, rid in enumerate(ids):
+            slot = self.slots.get(int(rid))
+            if slot is not None:
+                self.res[slot] = rows[pos]
+
+
+class _HotTierCacheSurface:
+    """The hot-tier cache accessors both transports share (ISSUE 15):
+    hit/miss totals for health reports + registry deltas, and the host
+    bytes the sparse caches hold — bounded LRU stores in cache mode, the
+    full-size per-table caches (+ int8 residual slabs) otherwise."""
+
+    @property
+    def sparse_cache_hits(self) -> int:
+        """Pulled rows served from the hot-tier LRU (zero wire cost);
+        0 for full-cache clients."""
+        return sum(lru.hits for lru in self._lru.values())
+
+    @property
+    def sparse_cache_misses(self) -> int:
+        """Pulled rows that took a wire fetch + LRU slot; 0 for
+        full-cache clients."""
+        return sum(lru.misses for lru in self._lru.values())
+
+    def sparse_cache_bytes(self) -> int:
+        """Host bytes the sparse-table caches hold — the number the
+        hyperscale bench tripwire compares against the full-vocabulary
+        footprint."""
+        if self._cache_rows is not None:
+            return sum(lru.nbytes() for lru in self._lru.values())
+        total = sum(c.nbytes for c in self._cache.values())
+        if self._residual is not None:
+            total += sum(self._residual[i].nbytes for i in self._sparse)
+        return total
+
+
 _CLIENT_ORDINALS = itertools.count()
 
 
-class PSClient:
+class PSClient(_HotTierCacheSurface):
     """Worker-side connection: ``pull()`` / ``commit(delta)`` (reference:
     ``NetworkWorker.pull/commit``, SURVEY §2.10) — plus the pipelined
     fire-and-forget API the async hot path runs on
@@ -2468,7 +3010,8 @@ class PSClient:
                  shard_id: Optional[int] = None,
                  failover: Sequence[Tuple[str, int]] = (),
                  sparse_leaves: Sequence[int] = (),
-                 adaptive: bool = False):
+                 adaptive: bool = False,
+                 sparse_cache_rows: Optional[int] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -2492,13 +3035,26 @@ class PSClient:
                 raise ValueError(f"sparse leaf {i} must be a [rows, dim] "
                                  f"table, got {self.templates[i].shape}")
         self._sparse_set = frozenset(self._sparse)
-        self._cache: Dict[int, np.ndarray] = {
-            i: np.array(self.templates[i], np.float32) for i in self._sparse}
+        # hot-tier client caching (ISSUE 15): ``sparse_cache_rows=N``
+        # replaces the full-size per-table host cache (and, under int8,
+        # the full-size residual slab) with one bounded :class:`_RowLRU`
+        # per table — host memory scales with the configured hot tier,
+        # not the vocabulary.  A sparse pull then fetches only the rows
+        # NOT resident (hits are gathered locally at issue time, so a
+        # hot row costs zero wire), ``wait_weights`` hands back a
+        # ``[k, dim]`` row block aligned with the request ids instead of
+        # a full-shape table, and the client's own commits merge into
+        # resident rows in place.  ``None`` (default) keeps the PR-9
+        # full-cache path byte-identical.
+        _init_hot_tier(self, sparse_cache_rows, compress)
         self._sp_enc = net.VarFrameEncoder() if self._sparse else None
         # ids of in-flight sparse pulls, FIFO-aligned with the
         # ACTION_SPARSE_WEIGHTS entries in _pending (a reconnect re-issues
-        # from here, so it never clears with _pending)
-        self._sparse_pull_ids: Deque[List[np.ndarray]] = deque()
+        # from here, so it never clears with _pending).  Full-cache mode
+        # entries are the per-table id lists; cache mode entries are
+        # richer records (request ids + the partially-gathered result
+        # blocks + the miss subsets the wire was asked for)
+        self._sparse_pull_ids: Deque[Any] = deque()
         # per-shard connection of a striped client (ShardedPSClient): every
         # client-side metric/span carries the shard label so the per-shard
         # wall/wire decomposition is readable straight off the registry.
@@ -2511,7 +3067,13 @@ class PSClient:
         # would let a replacement client's failover land inside the dead
         # client's cooldown and vanish
         self._client_ordinal = next(_CLIENT_ORDINALS)
-        self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
+        # int8 error-feedback residuals: full-shape per leaf — except the
+        # sparse leaves of a hot-tier client, whose residuals live in the
+        # bounded LRU slots (None placeholders keep leaf alignment)
+        self._residual = ([None if (self._cache_rows is not None
+                                    and i in self._sparse_set)
+                           else np.zeros(t.shape, np.float32)
+                           for i, t in enumerate(self.templates)]
                           if compress else None)
         self._codec = net.FlatFrameCodec(self.templates)
         # int8 commits have their own fixed layout (4-byte scale + one int8
@@ -2522,8 +3084,18 @@ class PSClient:
         self.max_inflight = max(1, int(max_inflight))
         self._pending: Deque[Tuple[bytes, float]] = deque()  # expected replies, wire order
         self._pull_frame = net.empty_tensor_frame(net.ACTION_PULL)
-        self._pull_bufs = ([np.empty_like(t) for t in self.templates],
-                          [np.empty_like(t) for t in self.templates])
+        # hot-tier mode keeps NO preallocated full-shape landing storage
+        # for sparse leaves (that storage is the memory the LRU bounds);
+        # the rare full pull (initial seed, explicit re-sync) lands those
+        # slots in transient arrays allocated per call
+        if self._cache_rows is None:
+            self._pull_bufs = ([np.empty_like(t) for t in self.templates],
+                               [np.empty_like(t) for t in self.templates])
+        else:
+            self._pull_bufs = tuple(
+                [None if i in self._sparse_set else np.empty_like(t)
+                 for i, t in enumerate(self.templates)]
+                for _ in range(2))
         self._flip = 0
         # weights replies consumed off the wire but not yet claimed by
         # wait_weights (commit_nowait pre-drains them — see below); two
@@ -2856,10 +3428,15 @@ class PSClient:
                         else:
                             # re-ask for the SAME rows; the reply observes
                             # the restarted hub's current center like any
-                            # re-issued pull
+                            # re-issued pull (hot-tier records re-send
+                            # their recorded MISS subset — the hit rows
+                            # were resolved locally at issue time)
+                            sp = self._sparse_pull_ids[si]
                             self._sp_enc.send(self.sock,
                                               net.ACTION_SPARSE_PULL,
-                                              self._sparse_pull_ids[si])
+                                              sp["miss"]
+                                              if isinstance(sp, dict)
+                                              else sp)
                             si += 1
                         self._pending.append((kind, time.perf_counter()))
                     self._last_io = time.monotonic()
@@ -2957,7 +3534,20 @@ class PSClient:
                              f"{len(self._sparse)} sparse tables")
         ids_list = [net.normalize_row_ids(ids, self.templates[i].shape[0])
                     for ids, i in zip(sparse_rows, self._sparse)]
-        self._resilient(lambda: self._sparse_pull_once(ids_list))
+        if self._cache_rows is None:
+            self._resilient(lambda: self._sparse_pull_once(ids_list))
+            return
+        # hot-tier path: resolve hits against the LRU NOW (their values
+        # are copied into the result blocks at this instant — the center
+        # state a full-cache client's pull would also have observed at
+        # issue time) and ask the wire for only the misses.  The gather
+        # runs once, outside the retry loop: a reconnect re-sends the
+        # SAME miss subset
+        blocks, miss_pos, miss = _hot_tier_gather(self, ids_list)
+        record = {"ids": ids_list, "out": blocks, "miss_pos": miss_pos,
+                  "miss": miss}
+        self._resilient(lambda: self._sparse_pull_once(record["miss"],
+                                                       record=record))
 
     def _pull_nowait_once(self) -> None:
         with self._io_lock:
@@ -2965,12 +3555,14 @@ class PSClient:
             self._pending.append((net.ACTION_WEIGHTS, time.perf_counter()))
             self._last_io = time.monotonic()
 
-    def _sparse_pull_once(self, ids_list: List[np.ndarray]) -> None:
+    def _sparse_pull_once(self, ids_list: List[np.ndarray],
+                          record: Optional[Dict[str, Any]] = None) -> None:
         with self._io_lock:
             self._sp_enc.send(self.sock, net.ACTION_SPARSE_PULL, ids_list)
             self._pending.append((net.ACTION_SPARSE_WEIGHTS,
                                   time.perf_counter()))
-            self._sparse_pull_ids.append(ids_list)
+            self._sparse_pull_ids.append(
+                ids_list if record is None else record)
             self._last_io = time.monotonic()
 
     def commit_nowait(self, delta: Sequence[np.ndarray],
@@ -3024,9 +3616,12 @@ class PSClient:
                                  f"has {len(self._sparse)} sparse tables")
             ids_list = [net.normalize_row_ids(ids, self.templates[i].shape[0])
                         for ids, i in zip(sparse_rows, self._sparse)]
-            arrays = _sparse_commit_arrays(
-                delta, self.templates, self._sparse_set, ids_list,
-                self._residual, self.compress)
+            if self._cache_rows is None:
+                arrays = _sparse_commit_arrays(
+                    delta, self.templates, self._sparse_set, ids_list,
+                    self._residual, self.compress)
+            else:
+                arrays = self._cached_commit_arrays(delta, ids_list)
             action = (net.ACTION_SPARSE_QCOMMIT if self.compress == "int8"
                       else net.ACTION_SPARSE_COMMIT)
             frame = self._sp_enc.pack(action, arrays)
@@ -3063,6 +3658,30 @@ class PSClient:
             self._last_io = time.monotonic()
         if telemetry:
             obs.gauge("ps.inflight_depth", **self._mlabels).set(self._unacked())
+
+    def _cached_commit_arrays(self, delta: Sequence[np.ndarray],
+                              ids_list: List[np.ndarray]) -> List[np.ndarray]:
+        """Hot-tier twin of :func:`_sparse_commit_arrays`: U/X wire blobs
+        for one commit with the per-row state read from the bounded LRU
+        instead of full-shape slabs.  Three extra duties:
+
+        - **flush union**: row ids whose int8 residuals were evicted
+          since the last commit join this commit's id set (their delta
+          rows are the model's true gradient for those rows — zero when
+          untouched — plus the flushed residual), so eviction never
+          loses error-feedback state;
+        - **slot residuals**: carried/stored per resident row; a row
+          evicted AND flushed in the same interval contributes both its
+          pending and (zeroed-at-reinsert) slot residual exactly once;
+        - **hits merge in place**: the post-wire committed rows (the
+          exact values the hub will apply at scale 1) fold into resident
+          LRU entries, so a hot row's cached value tracks this client's
+          own progress between misses.
+
+        With ``cache_rows >= vocabulary`` (no evictions) the produced
+        wire bytes are identical to the full-slab path's — the
+        trajectory-parity property ``tests/test_hyperscale.py`` pins."""
+        return _hot_tier_commit_arrays(self, delta, ids_list)
 
     def wait_weights(self) -> List[np.ndarray]:
         """Hand out the oldest in-flight pull, consuming replies (and any
@@ -3148,9 +3767,14 @@ class PSClient:
         if kind == net.ACTION_SPARSE_WEIGHTS:
             # sparse pull reply: dense leaves scatter into the flip
             # landing buffers exactly like a full pull, row blocks land in
-            # per-pull scratch and merge into the table caches; the
-            # full-order result hands the caches out in the sparse slots
-            ids_list = self._sparse_pull_ids[0]
+            # per-pull scratch.  Full-cache mode merges them into the
+            # per-table caches and hands the caches out; hot-tier mode
+            # files the MISS rows into their result-block positions and
+            # the LRU (hit rows were gathered at issue time), handing the
+            # [k, dim] blocks out instead of full-shape tables
+            entry = self._sparse_pull_ids[0]
+            cached = isinstance(entry, dict)
+            ids_list = entry["miss"] if cached else entry
             bufs = self._pull_bufs[self._flip]
             self._flip ^= 1
             out: List[np.ndarray] = []
@@ -3175,16 +3799,28 @@ class PSClient:
             self._sparse_pull_ids.popleft()
             result: List[np.ndarray] = []
             si = 0
+            misses0 = self.sparse_cache_misses
             for i in range(len(self.templates)):
                 if i in self._sparse_set:
-                    ids = ids_list[si]
-                    if ids.size:
-                        self._cache[i][ids] = out[i]
-                    result.append(self._cache[i])
+                    if cached:
+                        block = entry["out"][si]
+                        mp = entry["miss_pos"][si]
+                        if mp.size:
+                            block[mp] = out[i]
+                        _hot_tier_file_misses(self, i, entry["miss"][si],
+                                              out[i])
+                        result.append(block)
+                    else:
+                        ids = ids_list[si]
+                        if ids.size:
+                            self._cache[i][ids] = out[i]
+                        result.append(self._cache[i])
                     si += 1
                 else:
                     result.append(out[i])
             self._ready.append(result)
+            if cached:
+                _count_cache_misses(self, misses0)
             if obs.enabled():
                 obs.histogram("ps.pull_latency_ms", **self._mlabels).observe(
                     (time.perf_counter() - t_sent) * 1e3)
@@ -3202,8 +3838,17 @@ class PSClient:
                 obs.gauge("ps.inflight_depth", **self._mlabels).set(
                     self._unacked())
         else:
-            out = self._pull_bufs[self._flip]
+            bufs = self._pull_bufs[self._flip]
             self._flip ^= 1
+            if self._cache_rows is None:
+                out = bufs
+            else:
+                # hot-tier mode holds no full-shape landing storage for
+                # sparse leaves — the rare full pull (initial seed,
+                # explicit re-sync) lands them in transient arrays that
+                # die with the caller's reference
+                out = [np.empty_like(t) if b is None else b
+                       for b, t in zip(bufs, self.templates)]
             try:
                 reply = self._codec.recv_into(self.sock, out)
                 if reply != net.ACTION_WEIGHTS:
@@ -3219,9 +3864,13 @@ class PSClient:
             self._last_io = time.monotonic()  # lint: unguarded-ok receive leg runs outside the io lock by design; the _consuming flag excludes the heartbeat's round trips, and a racing timestamp store only under-reports idleness
             # a full pull re-seeds the sparse caches: the landing buffer
             # is reused two pulls later, the cache is the stable copy the
-            # sparse exchange merges into
+            # sparse exchange merges into.  Hot-tier mode seeds/refreshes
+            # its bounded LRU instead (_hot_tier_seed)
             for i in self._sparse:
-                self._cache[i][...] = out[i]
+                if self._cache_rows is None:
+                    self._cache[i][...] = out[i]
+                else:
+                    _hot_tier_seed(self, i, out[i])
             self._ready.append(out)
             if obs.enabled():
                 obs.histogram("ps.pull_latency_ms", **self._mlabels).observe(
@@ -3268,7 +3917,7 @@ class PSClient:
         self.close()
 
 
-class InprocPSClient:
+class InprocPSClient(_HotTierCacheSurface):
     """:class:`PSClient` surface over a co-located hub (``transport="inproc"``).
 
     Pull/commit call the SAME center logic the socket handlers run —
@@ -3289,7 +3938,8 @@ class InprocPSClient:
     def __init__(self, ps: Any, templates: Sequence[np.ndarray],
                  compress: Optional[str] = None,
                  trace_context: Optional["dtrace.TraceContext"] = None,
-                 sparse_leaves: Sequence[int] = ()):
+                 sparse_leaves: Sequence[int] = (),
+                 sparse_cache_rows: Optional[int] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.ps = ps
@@ -3299,20 +3949,27 @@ class InprocPSClient:
         # socket client's cache-and-merge behavior over the hub's direct
         # sparse pair, so sparse runs stay trajectory-identical across
         # transports (no wire to save here — parity is the point).
-        # Requires a co-located hub exposing pull_sparse_direct (the
-        # unsharded Python hubs); the sharded facade has no sparse direct
-        # pair — the trainer falls back to the dense direct exchange there
+        # Requires a co-located hub exposing pull_sparse_direct (both
+        # unsharded hub implementations); the sharded facade has no
+        # sparse direct pair — the trainer raises there
         self._sparse = tuple(sorted({int(i) for i in sparse_leaves}))
         self._sparse_set = frozenset(self._sparse)
-        self._cache: Dict[int, np.ndarray] = {
-            i: np.array(self.templates[i], np.float32) for i in self._sparse}
+        # hot-tier mode (ISSUE 15): the exact PSClient semantics minus
+        # the wire — hits gather from the bounded LRU at pull time,
+        # misses go through the direct pair, own commits merge in place
+        # (one shared constructor with the socket client, so the two
+        # transports' cache state can never drift)
+        _init_hot_tier(self, sparse_cache_rows, compress)
         if self._sparse and not hasattr(ps, "pull_sparse_direct"):
             raise ValueError(
                 f"sparse_leaves need a hub with a sparse direct pair "
                 f"(pull_sparse_direct/commit_sparse_direct); "
                 f"{type(ps).__name__} has none — use the socket transport "
-                f"or an unsharded Python hub")
-        self._residual = ([np.zeros(t.shape, np.float32) for t in self.templates]
+                f"or an unsharded hub")
+        self._residual = ([None if (self._cache_rows is not None
+                                    and i in self._sparse_set)
+                           else np.zeros(t.shape, np.float32)
+                           for i, t in enumerate(self.templates)]
                           if compress else None)
         self._last_pull_clock = 0
         self._pulled: Optional[List[np.ndarray]] = None
@@ -3361,6 +4018,32 @@ class InprocPSClient:
             ids_list = [net.normalize_row_ids(ids,
                                               self.templates[i].shape[0])
                         for ids, i in zip(sparse_rows, self._sparse)]
+            if self._cache_rows is not None:
+                # hot-tier: gather hits now, direct-pull only the misses,
+                # file them, hand back [k, dim] blocks (PSClient parity —
+                # the same shared helpers, so the transports can't drift)
+                blocks, miss_pos, miss = _hot_tier_gather(self, ids_list)
+                misses0 = self.sparse_cache_misses
+                values, clock = self.ps.pull_sparse_direct(miss)
+                result = []
+                si = 0
+                for i, v in enumerate(values):
+                    if i in self._sparse_set:
+                        if miss_pos[si].size:
+                            blocks[si][miss_pos[si]] = v
+                        _hot_tier_file_misses(self, i, miss[si],
+                                              np.asarray(v, np.float32))
+                        result.append(blocks[si])
+                        si += 1
+                    else:
+                        result.append(v)
+                _count_cache_misses(self, misses0)
+                self._last_pull_clock = clock
+                self._pulled = result
+                if telemetry:
+                    obs.histogram("ps.pull_latency_ms").observe(
+                        (time.perf_counter() - t0) * 1e3)
+                return
             values, clock = self.ps.pull_sparse_direct(ids_list)
             result: List[np.ndarray] = []
             si = 0
@@ -3378,7 +4061,10 @@ class InprocPSClient:
         else:
             weights, clock = self.ps.pull_direct()
             for i in self._sparse:
-                self._cache[i][...] = weights[i]
+                if self._cache_rows is None:
+                    self._cache[i][...] = weights[i]
+                else:
+                    _hot_tier_seed(self, i, weights[i])
             self._last_pull_clock = clock
             self._pulled = weights
         if telemetry:
@@ -3411,9 +4097,12 @@ class InprocPSClient:
                 # same row gather + quantize/residual math as the wire
                 # path, then straight back through the dequantizer — what
                 # the hub would have reconstructed from the U/X frame
-                arrays = _sparse_commit_arrays(
-                    delta, self.templates, self._sparse_set, ids_list,
-                    self._residual, self.compress)
+                if self._cache_rows is None:
+                    arrays = _sparse_commit_arrays(
+                        delta, self.templates, self._sparse_set, ids_list,
+                        self._residual, self.compress)
+                else:
+                    arrays = _hot_tier_commit_arrays(self, delta, ids_list)
                 parts = _sparse_parts_from_arrays(
                     arrays, self.templates, self._sparse_set, self.compress)
                 self.ps.commit_sparse_direct(parts, self._last_pull_clock)
@@ -4042,7 +4731,17 @@ class ShardedPSClient:
                  trace_context: Optional["dtrace.TraceContext"] = None,
                  failover: Optional[Sequence[Any]] = None,
                  sparse_leaves: Sequence[int] = (),
-                 adaptive: bool = False):
+                 adaptive: bool = False,
+                 sparse_cache_rows: Optional[int] = None):
+        if sparse_cache_rows is not None:
+            # the striped client's whole sparse design is row-range VIEWS
+            # of one full-size cache; a bounded hot tier would need
+            # per-shard LRU partitioning of the row ranges — documented
+            # unsupported combination (MIGRATION.md), loud at construction
+            raise ValueError(
+                "sparse_cache_rows is not supported on the sharded client: "
+                "hot-tier caching needs num_shards=1 (PSClient/"
+                "InprocPSClient) — drop sparse_cache_rows or the sharding")
         if len(addresses) != plan.num_shards:
             raise ValueError(f"got {len(addresses)} shard addresses, plan "
                              f"has {plan.num_shards} shards")
@@ -4198,6 +4897,14 @@ class ShardedPSClient:
     @property
     def failovers_used(self) -> int:
         return sum(c.failovers_used for c in self.shards)
+
+    @property
+    def sparse_cache_hits(self) -> int:
+        return sum(c.sparse_cache_hits for c in self.shards)
+
+    @property
+    def sparse_cache_misses(self) -> int:
+        return sum(c.sparse_cache_misses for c in self.shards)
 
     def report_health(self, report: Dict[str, Any]) -> None:
         """Push one report over the SHARD-0 connection only: a striped

@@ -247,6 +247,12 @@ int connect_to(const char* host, int port, int timeout_ms) {
 constexpr int kReplDelta = 0;
 constexpr int kReplSync = 1;
 constexpr int kReplHello = 2;
+// sparse row-delta frame (ISSUE 15): blobs past the header carry the
+// U-commit layout (dense leaves whole, sparse leaves as ids + scaled
+// rows).  Sent only to replicas whose hello announced kReplCapSparse
+// (optional 10th header byte); legacy replicas keep the dense stream.
+constexpr int kReplSparse = 3;
+constexpr int kReplCapSparse = 1;
 
 // one leaf of an incoming commit, aliasing the connection's receive buffer
 // (or its dequantize scratch) — consumed before the next frame lands, the
@@ -390,7 +396,7 @@ class ParameterServer {
     S_MERGE_BATCHES, S_MERGED_COMMITS, S_MAX_MERGE_BATCH,
     S_BACKPRESSURE_HINTS, S_REPL_FRAMES, S_PROMOTIONS,
     S_HEALTH_DROPPED, S_IS_STANDBY, S_PROMOTED, S_PROMOTED_AT_CLOCK,
-    S_SYNCED, kStatCount
+    S_SYNCED, S_REPL_SPARSE_BYTES, S_REPL_SPARSE_SAVED, kStatCount
   };
   static constexpr int kStaleSlots = 64;   // exact small-int histograms
   static constexpr int kStripes = 16;      // apply-lock striping
@@ -417,6 +423,11 @@ class ParameterServer {
       int leaf = int(sparse_leaves[s]);
       sparse_dim_[size_t(leaf)] = sparse_dims[s];
       sparse_leaves_.push_back(leaf);
+    }
+    for (int leaf : sparse_leaves_) {
+      int64_t rows = sizes_[size_t(leaf)] / sparse_dim_[size_t(leaf)];
+      sparse_touch_.emplace_back(size_t(rows), 0.0f);
+      hot_rows_.push_back(0);
     }
     center_.assign(size_t(total), 0.0f);
     center_bytes_ = total * int64_t(sizeof(float));
@@ -636,6 +647,112 @@ class ParameterServer {
     return 0;
   }
 
+  // -- sparse in-process transport (ISSUE 15) ---------------------------------
+  // pull_sparse_direct minus the frame (the S/V exchange): ``ids`` is the
+  // concatenation of each sparse table's sorted-unique row ids
+  // (``counts[s]`` per table, sparse_leaves_ order); ``out`` receives the
+  // per-leaf values in template order — dense leaves whole, sparse
+  // leaves their [k, dim] row blocks.  Returns the snapshot clock,
+  // -1 = never-synced standby refusal, -2 = invalid row ids.
+  int64_t pull_sparse_direct(const int64_t* ids, const int64_t* counts,
+                             float* out) {
+    if (standby_.load() && !synced_.load()) return -1;
+    {
+      const int64_t* p = ids;
+      for (size_t s = 0; s < sparse_leaves_.size(); ++s) {
+        if (!check_row_ids(p, counts[s], size_t(sparse_leaves_[s])))
+          return -2;
+        p += counts[s];
+      }
+    }
+    std::unique_lock<std::shared_mutex> g(gate_);
+    const int64_t* ip = ids;
+    float* op = out;
+    int64_t rows_pulled = 0, raw = 0;
+    size_t s = 0;
+    for (size_t i = 0; i < sizes_.size(); ++i) {
+      const float* c = center_.data() + offsets_[i];
+      if (sparse_dim_[i] > 0) {
+        int64_t dim = sparse_dim_[i];
+        int64_t k = counts[s];
+        for (int64_t r = 0; r < k; ++r)
+          std::memcpy(op + r * dim, c + ip[r] * dim, size_t(dim) * 4);
+        ip += k;
+        op += k * dim;
+        rows_pulled += k;
+        raw += k * dim * 4;
+        ++s;
+      } else {
+        std::memcpy(op, c, size_t(sizes_[i]) * 4);
+        op += sizes_[i];
+        raw += sizes_[i] * 4;
+      }
+    }
+    int64_t clock;
+    {
+      std::lock_guard<std::mutex> m(meta_);
+      clock = clock_;
+      ++pulls_;
+      pull_bytes_ += raw;
+      sparse_rows_pulled_ += rows_pulled;
+      {
+        const int64_t* tp = ids;
+        for (size_t t = 0; t < sparse_leaves_.size(); ++t) {
+          touch_ids_locked(t, tp, counts[t]);
+          tp += counts[t];
+        }
+        fold_touch_locked();
+      }
+    }
+    return clock;
+  }
+
+  // commit_sparse_direct minus the frame (the U exchange): ``vals`` is
+  // the concatenation of per-leaf payloads in template order (full f32
+  // delta for dense leaves, [k, dim] row grads for sparse ones), ids/
+  // counts as in pull_sparse_direct.  0 = applied, 1 = refused (never-
+  // synced standby), 2 = refused (standby probing a live primary),
+  // 3 = invalid row ids — runtime/native.py raises on nonzero.
+  int commit_sparse_direct(const float* vals, const int64_t* ids,
+                           const int64_t* counts, int64_t last_pull_clock,
+                           int64_t worker) {
+    std::vector<PartView> parts(sizes_.size());
+    const float* vp = vals;
+    const int64_t* ip = ids;
+    size_t s = 0;
+    int64_t rows = 0, raw = 0;
+    for (size_t i = 0; i < sizes_.size(); ++i) {
+      if (sparse_dim_[i] > 0) {
+        int64_t k = counts[s];
+        if (!check_row_ids(ip, k, i)) return 3;
+        parts[i].sparse = true;
+        parts[i].ids = ip;
+        parts[i].k = k;
+        parts[i].vals = vp;
+        ip += k;
+        vp += k * sparse_dim_[i];
+        rows += k;
+        raw += k * (8 + sparse_dim_[i] * 4);
+        ++s;
+      } else {
+        parts[i].vals = vp;
+        vp += sizes_[i];
+        raw += sizes_[i] * 4;
+      }
+    }
+    if (standby_.load()) {
+      if (!synced_.load()) return 1;
+      int fd = replica_fd_.load();
+      if (fd >= 0) {
+        ::shutdown(fd, SHUT_RDWR);
+        return 2;
+      }
+      promote();  // feed down: its owner considers this the live hub
+    }
+    commit_parts(parts, &last_pull_clock, worker, raw, rows, 0);
+    return 0;
+  }
+
   // -- telemetry exports ------------------------------------------------------
   void stats(int64_t out[kStatCount]) {
     std::lock_guard<std::mutex> m(meta_);
@@ -665,6 +782,15 @@ class ParameterServer {
     out[S_PROMOTED] = promoted_flag_.load() ? 1 : 0;
     out[S_PROMOTED_AT_CLOCK] = promoted_at_clock_;
     out[S_SYNCED] = synced_.load() ? 1 : 0;
+    out[S_REPL_SPARSE_BYTES] = repl_sparse_bytes_;
+    out[S_REPL_SPARSE_SAVED] = repl_sparse_saved_;
+  }
+
+  // decayed per-table hot-set estimates (one int64 per sparse leaf, in
+  // sparse_leaves_ order) — dk_ps_hot_rows
+  void hot_rows(int64_t* out) {
+    std::lock_guard<std::mutex> m(meta_);
+    for (size_t s = 0; s < hot_rows_.size(); ++s) out[s] = hot_rows_[s];
   }
 
   void staleness_hist(int64_t out[kStaleSlots + 1]) {
@@ -759,7 +885,11 @@ class ParameterServer {
   // -- replication feed (primary side; Python's ReplicationFeed twin) --------
   // attach full-syncs under the write gate, publish streams one R delta
   // frame per applied commit BEFORE the worker's ack leaves.  A replica's
-  // immutable attach-time sync clock filters deltas its sync covered.
+  // immutable attach-time sync clock filters deltas its sync covered;
+  // its attach-time hello capability decides which frame KINDS it is
+  // ever sent — row-sparse commits go to kReplCapSparse replicas as one
+  // kReplSparse row-delta frame (cost ∝ touched rows) and to legacy
+  // replicas as the dense-materialized kReplDelta.
   struct ReplFeed {
     explicit ReplFeed(ParameterServer* hub) : hub(hub) {}
     ParameterServer* hub;
@@ -767,10 +897,28 @@ class ParameterServer {
     struct Rep {
       int fd;
       int64_t sync_clock;
+      bool sparse_ok;
     };
     std::vector<Rep> conns_;
     std::atomic<int> count_{0};
+    // legacy (dense-only) replicas attached: the commit path reads this
+    // lock-free to decide whether a sparse commit must ALSO materialize
+    // the center-shaped delta.  Racy by design: a legacy replica
+    // attaching concurrently snapshots the center AFTER the commit
+    // applied, so its sync clock covers the commit either way
+    std::atomic<int> dense_count_{0};
     std::vector<unsigned char> tx_;
+    std::vector<unsigned char> sp_tx_;
+    std::vector<float> fb_dense_;  // densify-on-demand scratch (lock_)
+
+    // what a kReplSparse frame is packed from: the plain path's wire
+    // views (scaled while packing — the same `scale * g` float product
+    // the apply computed) or the adaptive path's pre-scaled owned parts
+    struct SparseSrc {
+      const std::vector<PartView>* views = nullptr;
+      const std::vector<OwnedPart>* owned = nullptr;
+      float scale = 1.0f;
+    };
 
     // frame: [u64 len][R][u32 1+L][u64 9][9-byte hdr][per leaf u64+f32s]
     void pack_frame(int64_t clock, int kind, const float* flat) {
@@ -796,7 +944,71 @@ class ParameterServer {
       }
     }
 
-    bool attach(int fd) {
+    // row-delta frame (kReplSparse): header blob + the U-commit layout —
+    // dense leaves whole, sparse leaves as (ids, scaled rows)
+    void pack_sparse(int64_t clock, const SparseSrc& sp) {
+      const auto& sizes = hub->sizes_;
+      const auto& dims = hub->sparse_dim_;
+      size_t payload = 5 + 8 + 9;
+      for (size_t i = 0; i < sizes.size(); ++i) {
+        int64_t k = sp.views ? ((*sp.views)[i].sparse ? (*sp.views)[i].k : -1)
+                             : ((*sp.owned)[i].sparse
+                                    ? int64_t((*sp.owned)[i].ids.size())
+                                    : -1);
+        if (k >= 0)
+          payload += 8 + size_t(k) * 8 + 8 + size_t(k * dims[i]) * 4;
+        else
+          payload += 8 + size_t(sizes[i]) * 4;
+      }
+      sp_tx_.resize(8 + payload);
+      unsigned char* p = sp_tx_.data();
+      be64_encode(payload, p);
+      p[8] = 'R';
+      be32_encode(uint32_t(1 + sizes.size() + hub->sparse_leaves_.size()),
+                  p + 9);
+      p += 13;
+      be64_encode(9, p);
+      p += 8;
+      be64_encode(uint64_t(clock), p);
+      p[8] = (unsigned char)kReplSparse;
+      p += 9;
+      for (size_t i = 0; i < sizes.size(); ++i) {
+        bool sparse = sp.views ? (*sp.views)[i].sparse
+                               : (*sp.owned)[i].sparse;
+        const int64_t* ids = nullptr;
+        const float* vals;
+        int64_t k = 0, nvals;
+        if (sp.views) {
+          const PartView& v = (*sp.views)[i];
+          ids = v.ids;
+          vals = v.vals;
+          k = v.k;
+          nvals = sparse ? k * dims[i] : sizes[i];
+        } else {
+          const OwnedPart& o = (*sp.owned)[i];
+          ids = o.ids.data();
+          vals = o.vals.data();
+          k = int64_t(o.ids.size());
+          nvals = int64_t(o.vals.size());
+        }
+        if (sparse) {
+          be64_encode(uint64_t(k) * 8, p);
+          p += 8;
+          std::memcpy(p, ids, size_t(k) * 8);
+          p += size_t(k) * 8;
+        }
+        be64_encode(uint64_t(nvals) * 4, p);
+        p += 8;
+        float* out = reinterpret_cast<float*>(p);
+        if (sp.scale == 1.0f)
+          std::memcpy(out, vals, size_t(nvals) * 4);
+        else
+          for (int64_t j = 0; j < nvals; ++j) out[j] = sp.scale * vals[j];
+        p += size_t(nvals) * 4;
+      }
+    }
+
+    bool attach(int fd, int caps) {
       timeval tv{30, 0};  // REPLICA_SEND_TIMEOUT: a stuck replica must
       ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));  // not
       std::lock_guard<std::mutex> l(lock_);  // park the commit plane
@@ -817,8 +1029,10 @@ class ParameterServer {
         ::close(fd);
         return false;
       }
-      conns_.push_back({fd, clock});
+      bool sparse_ok = (caps & kReplCapSparse) != 0;
+      conns_.push_back({fd, clock, sparse_ok});
       count_.store(int(conns_.size()));
+      if (!sparse_ok) dense_count_.fetch_add(1);
       {
         std::lock_guard<std::mutex> m(hub->meta_);
         ++hub->replicas_attached_;
@@ -826,23 +1040,61 @@ class ParameterServer {
       return true;
     }
 
-    void publish(int64_t clock, const float* dense) {
+    // `dense` may be nullptr when the commit path observed only
+    // sparse-capable replicas (then `sp` must be set); a LEGACY replica
+    // whose attach raced that lock-free check is still served here by
+    // densifying on demand under the feed lock — the Python feed's
+    // exact contract (its registered sync clock snapshots BEFORE this
+    // commit applied, so skipping it would lose the delta forever)
+    void publish(int64_t clock, const float* dense,
+                 const SparseSrc* sp = nullptr) {
       std::lock_guard<std::mutex> l(lock_);
       if (conns_.empty()) return;
-      bool packed = false;
+      bool packed = false, sp_packed = false;
       std::vector<size_t> dead;
       for (size_t i = 0; i < conns_.size(); ++i) {
         if (conns_[i].sync_clock >= clock) continue;  // covered by sync
-        if (!packed) {
-          pack_frame(clock, kReplDelta, dense);
-          packed = true;
+        bool ok;
+        if (sp != nullptr && conns_[i].sparse_ok) {
+          if (!sp_packed) {
+            pack_sparse(clock, *sp);
+            sp_packed = true;
+          }
+          ok = write_all(conns_[i].fd, sp_tx_.data(), sp_tx_.size());
+          if (ok) {
+            std::lock_guard<std::mutex> m(hub->meta_);
+            hub->repl_sparse_bytes_ += int64_t(sp_tx_.size());
+            int64_t dense_frame = 8 + 5 + 8 + 9;
+            for (int64_t s : hub->sizes_) dense_frame += 8 + s * 4;
+            int64_t saved = dense_frame - int64_t(sp_tx_.size());
+            if (saved > 0) hub->repl_sparse_saved_ += saved;
+          }
+        } else {
+          if (dense == nullptr) {
+            // densify-on-demand: scatter the scaled sparse parts into a
+            // center-shaped scratch (materialize_* take no locks; the
+            // commit's part views stay valid — publish is synchronous
+            // within the committing call)
+            fb_dense_.assign(hub->center_.size(), 0.0f);
+            if (sp->views != nullptr)
+              hub->materialize_views(*sp->views, sp->scale,
+                                     fb_dense_.data());
+            else
+              hub->materialize_owned(*sp->owned, fb_dense_.data());
+            dense = fb_dense_.data();
+          }
+          if (!packed) {
+            pack_frame(clock, kReplDelta, dense);
+            packed = true;
+          }
+          ok = write_all(conns_[i].fd, tx_.data(), tx_.size());
         }
-        if (!write_all(conns_[i].fd, tx_.data(), tx_.size()))
-          dead.push_back(i);
+        if (!ok) dead.push_back(i);
       }
       for (size_t d = dead.size(); d > 0; --d) {
         size_t i = dead[d - 1];
         ::close(conns_[i].fd);
+        if (!conns_[i].sparse_ok) dense_count_.fetch_sub(1);
         conns_.erase(conns_.begin() + long(i));
         std::lock_guard<std::mutex> m(hub->meta_);
         ++hub->replica_disconnects_;
@@ -858,6 +1110,7 @@ class ParameterServer {
       }
       conns_.clear();
       count_.store(0);
+      dense_count_.store(0);
     }
   };
 
@@ -890,6 +1143,45 @@ class ParameterServer {
       return 1.0;
     }
     return it->second.first;
+  }
+
+  // -- row-touch telemetry (ISSUE 15; caller holds meta_) ---------------------
+  // per-table exponentially-decayed touch counters: +1 per touched row
+  // per sparse request, halved every kTouchDecayEvery folds; rows still
+  // >= 1 then estimate the live hot set (dk_ps_hot_rows — the wrapper
+  // surfaces them as ps.sparse_hot_rows{table=} gauges)
+  static constexpr int kTouchDecayEvery = 64;
+
+  void fold_touch_locked() {
+    if (++touch_folds_ < kTouchDecayEvery) return;
+    touch_folds_ = 0;
+    for (size_t s = 0; s < sparse_touch_.size(); ++s) {
+      int64_t hot = 0;
+      for (float& v : sparse_touch_[s]) {
+        v *= 0.5f;
+        if (v >= 1.0f) ++hot;
+      }
+      hot_rows_[s] = hot;
+    }
+  }
+
+  void touch_ids_locked(size_t table, const int64_t* ids, int64_t k) {
+    auto& t = sparse_touch_[table];
+    for (int64_t r = 0; r < k; ++r) t[size_t(ids[r])] += 1.0f;
+  }
+
+  void touch_rows_locked(const std::vector<PartView>& parts) {
+    bool any = false;
+    size_t s = 0;
+    for (size_t i = 0; i < parts.size(); ++i) {
+      if (sparse_dim_[i] <= 0) continue;
+      if (parts[i].sparse) {
+        touch_ids_locked(s, parts[i].ids, parts[i].k);
+        any = true;
+      }
+      ++s;
+    }
+    if (any) fold_touch_locked();
   }
 
   // caller holds meta_: one commit-log record + the exact staleness count.
@@ -1031,8 +1323,27 @@ class ParameterServer {
     }
     float scale = float(dscale);
     int64_t t0 = mono_ns();
+    bool sparse_commit = false;
+    for (const PartView& p : parts)
+      if (p.sparse) {
+        sparse_commit = true;
+        break;
+      }
+    // a row-sparse commit applies in its native form (touched rows only,
+    // the Python hub's idiom) and is FRAMED sparse for capable replicas;
+    // the center-shaped materialization now exists only when a legacy
+    // (dense-stream) replica is actually attached.  Dense commits keep
+    // the pre-ISSUE-15 path byte for byte
+    bool need_dense =
+        replicate && (!sparse_commit || feed_->dense_count_.load() > 0);
     std::vector<float> repl;
-    if (replicate) {
+    if (sparse_commit) {
+      apply_views(parts, scale);
+      if (need_dense) {
+        repl.assign(center_.size(), 0.0f);
+        materialize_views(parts, scale, repl.data());
+      }
+    } else if (replicate) {
       repl.assign(center_.size(), 0.0f);
       materialize_views(parts, scale, repl.data());
       add_from_flat(repl.data());
@@ -1046,11 +1357,21 @@ class ParameterServer {
       commit_bytes_ += wire_bytes;
       sparse_rows_committed_ += rows_committed;
       sparse_wire_saved_ += wire_saved;
+      if (sparse_commit)
+        touch_rows_locked(parts);
     }
     g.unlock();
     // the ack leaves only after this returns — the acked-commit-is-
     // kernel-owned replication contract (publish before ack)
-    if (replicate) feed_->publish(commit_clock, repl.data());
+    if (replicate) {
+      ReplFeed::SparseSrc sp;
+      if (sparse_commit) {
+        sp.views = &parts;
+        sp.scale = scale;
+      }
+      feed_->publish(commit_clock, need_dense ? repl.data() : nullptr,
+                     sparse_commit ? &sp : nullptr);
+    }
     num_updates_.fetch_add(1);
   }
 
@@ -1135,8 +1456,27 @@ class ParameterServer {
     } else {
       applied = std::move(scaled);
     }
+    // ONE applied commit (uncontended, or the whole batch Adasum-merged)
+    // that carries row-sparse parts applies sparse and streams as a
+    // kReplSparse row-union frame; the dense materialization exists only
+    // for the RARE sequential batch or an attached legacy replica
+    bool sparse_single = false;
+    if (applied.size() == 1)
+      for (const OwnedPart& p : applied[0])
+        if (p.sparse) {
+          sparse_single = true;
+          break;
+        }
+    bool need_dense =
+        replicate && (!sparse_single || feed_->dense_count_.load() > 0);
     std::vector<float> repl;
-    if (replicate) {
+    if (sparse_single) {
+      apply_owned(applied[0]);
+      if (need_dense) {
+        repl.assign(center_.size(), 0.0f);
+        materialize_owned(applied[0], repl.data());
+      }
+    } else if (replicate) {
       repl.assign(center_.size(), 0.0f);
       for (const auto& parts : applied) materialize_owned(parts, repl.data());
       add_from_flat(repl.data());
@@ -1151,6 +1491,7 @@ class ParameterServer {
         commit_bytes_ += e->wire_bytes;
         sparse_rows_committed_ += e->rows_committed;
         sparse_wire_saved_ += e->wire_saved;
+        touch_rows_locked(*e->parts);
       }
     }
     g.unlock();
@@ -1158,7 +1499,12 @@ class ParameterServer {
     // member is acked.  Like the Python hub, publish happens after the
     // apply lock is released: cross-thread publish-order inversions only
     // reorder float additions (the feed's documented tolerance class)
-    if (replicate) feed_->publish(commit_clock, repl.data());
+    if (replicate) {
+      ReplFeed::SparseSrc sp;
+      if (sparse_single) sp.owned = &applied[0];
+      feed_->publish(commit_clock, need_dense ? repl.data() : nullptr,
+                     sparse_single ? &sp : nullptr);
+    }
     num_updates_.fetch_add(int64_t(K));
     for (CommitEntry* e : batch) e->done = true;
   }
@@ -1188,8 +1534,18 @@ class ParameterServer {
   // SYNCED standby promotes itself — a never-synced one keeps retrying
   // (promoting fresh init weights would discard the job)
   void replica_loop() {
+    // a sparse-capable standby (this hub serves row-sparse tables)
+    // announces kReplCapSparse and must parse VARIABLE-size kReplSparse
+    // frames; a dense hub keeps the fixed-size stream byte for byte
+    bool sparse_feed = !sparse_leaves_.empty();
     size_t expect = size_t(dense_payload_f32_) + 17;  // + (8 + 9) hdr blob
-    std::vector<unsigned char> frame(expect);
+    size_t feed_limit = expect;
+    for (int leaf : sparse_leaves_)
+      feed_limit +=
+          8 + 8 * size_t(sizes_[size_t(leaf)] / sparse_dim_[size_t(leaf)]);
+    std::vector<unsigned char> frame(sparse_feed ? size_t(4096) : expect);
+    std::vector<std::pair<const unsigned char*, uint64_t>> fblobs;
+    std::vector<int64_t> fids;
     int failures = 0;
     while (!replica_stop_.load()) {
       int fd = connect_to(replica_host_.c_str(), replica_port_, 5000);
@@ -1199,11 +1555,13 @@ class ParameterServer {
       }
       if (fd >= 0) {
         replica_fd_.store(fd);
-        unsigned char hello[8 + 5 + 8 + 9];
-        be64_encode(5 + 8 + 9, hello);
+        size_t hdr_len = sparse_feed ? 10 : 9;
+        unsigned char hello[8 + 5 + 8 + 10];
+        size_t hello_len = 8 + 5 + 8 + hdr_len;
+        be64_encode(5 + 8 + hdr_len, hello);
         hello[8] = 'R';
         be32_encode(1, hello + 9);
-        be64_encode(9, hello + 13);
+        be64_encode(hdr_len, hello + 13);
         int64_t my_clock;
         {
           std::lock_guard<std::mutex> m(meta_);
@@ -1211,17 +1569,33 @@ class ParameterServer {
         }
         be64_encode(uint64_t(my_clock), hello + 21);
         hello[29] = (unsigned char)kReplHello;
-        bool ok = write_all(fd, hello, sizeof(hello));
+        if (sparse_feed) hello[30] = (unsigned char)kReplCapSparse;
+        bool ok = write_all(fd, hello, hello_len);
         while (ok && !replica_stop_.load()) {
           unsigned char hdr[8];
           if (!read_exact(fd, hdr, 8)) break;
-          if (be64_decode(hdr) != expect) break;  // protocol: desync
-          if (!read_exact(fd, frame.data(), expect)) break;
+          uint64_t n = be64_decode(hdr);
+          if (sparse_feed ? (n > feed_limit || n < 22) : (n != expect))
+            break;  // protocol: desync
+          if (frame.size() < n) frame.resize(size_t(n));
+          if (!read_exact(fd, frame.data(), size_t(n))) break;
           if (frame[0] != 'R') break;
-          if (be32_decode(frame.data() + 1) != 1 + sizes_.size()) break;
           if (be64_decode(frame.data() + 5) != 9) break;
           int64_t fclock = int64_t(be64_decode(frame.data() + 13));
           int kind = frame[21];
+          uint32_t nblobs = be32_decode(frame.data() + 1);
+          if (kind == kReplSparse) {
+            if (!sparse_feed) break;  // never announced the capability
+            if (nblobs != 1 + sizes_.size() + sparse_leaves_.size()) break;
+            if (!parse_blob_table(frame.data(), n, fblobs)) break;
+          } else {
+            // SYNC/DELTA are FIXED-size frames: pin the length exactly
+            // (the dense apply loops below walk per-leaf prefixes
+            // without re-bounding against n — a short frame must never
+            // reach them)
+            if (n != expect) break;
+            if (nblobs != 1 + sizes_.size()) break;
+          }
           const unsigned char* p = frame.data() + 22;
           {
             std::unique_lock<std::shared_mutex> g(gate_);
@@ -1250,6 +1624,49 @@ class ParameterServer {
                 float* dst = c + offsets_[i];
                 for (int64_t j = 0; j < sizes_[i]; ++j) dst[j] += d[j];
                 p += 8 + size_t(sizes_[i]) * 4;
+              }
+              if (!ok) break;
+              if (fclock > clock_) clock_ = fclock;
+              num_updates_.fetch_add(1);
+            } else if (kind == kReplSparse) {
+              // row-delta apply: center[ids] += rows for sparse leaves,
+              // whole-leaf adds for dense ones (the U-commit layout
+              // past the header blob)
+              size_t b = 1;
+              float* c = center_.data();
+              for (size_t i = 0; ok && i < sizes_.size(); ++i) {
+                if (sparse_dim_[i] > 0) {
+                  uint64_t idb = fblobs[b].second;
+                  if (idb % 8 != 0) { ok = false; break; }
+                  int64_t k = int64_t(idb / 8);
+                  fids.resize(size_t(k));
+                  std::memcpy(fids.data(), fblobs[b].first, size_t(k) * 8);
+                  if (!check_row_ids(fids.data(), k, i)) { ok = false; break; }
+                  int64_t dim = sparse_dim_[i];
+                  if (fblobs[b + 1].second != uint64_t(k * dim) * 4) {
+                    ok = false;
+                    break;
+                  }
+                  const float* rows =
+                      reinterpret_cast<const float*>(fblobs[b + 1].first);
+                  float* dst = c + offsets_[i];
+                  for (int64_t r = 0; r < k; ++r) {
+                    float* row = dst + fids[size_t(r)] * dim;
+                    const float* gsrc = rows + r * dim;
+                    for (int64_t j = 0; j < dim; ++j) row[j] += gsrc[j];
+                  }
+                  b += 2;
+                } else {
+                  if (fblobs[b].second != uint64_t(sizes_[i]) * 4) {
+                    ok = false;
+                    break;
+                  }
+                  const float* d =
+                      reinterpret_cast<const float*>(fblobs[b].first);
+                  float* dst = c + offsets_[i];
+                  for (int64_t j = 0; j < sizes_[i]; ++j) dst[j] += d[j];
+                  b += 1;
+                }
               }
               if (!ok) break;
               if (fclock > clock_) clock_ = fclock;
@@ -1715,6 +2132,9 @@ class ParameterServer {
             int64_t saved =
                 (8 + dense_payload_f32_) - int64_t(8 + vpayload);
             if (saved > 0) sparse_wire_saved_ += saved;
+            for (size_t s = 0; s < req.size(); ++s)
+              touch_ids_locked(s, req[s].first, req[s].second);
+            fold_touch_locked();
           }
         }
         if (!write_all(fd, sp_tx.data(), sp_tx.size())) break;
@@ -1760,11 +2180,14 @@ class ParameterServer {
 
       } else if (action == 'R') {
         // replica handshake: this peer is a hot standby, not a worker —
-        // attach it to the replication feed and hand the socket over
+        // attach it to the replication feed and hand the socket over.
+        // A 10th header byte (optional — legacy hellos are 9 bytes)
+        // carries the standby's frame-kind capabilities
         if (!parse_blob_table(payload, n, blobs) || blobs.size() != 1 ||
-            blobs[0].second != 9)
+            (blobs[0].second != 9 && blobs[0].second != 10))
           break;
         if (blobs[0].first[8] != kReplHello) break;
+        int repl_caps = blobs[0].second >= 10 ? int(blobs[0].first[9]) : 0;
         if (!flush_acks()) break;
         {
           std::lock_guard<std::mutex> m(meta_);
@@ -1776,7 +2199,7 @@ class ParameterServer {
                           conn_fds_.end());
         }
         handoff = true;
-        feed_->attach(fd);  // on failure attach closes the fd itself
+        feed_->attach(fd, repl_caps);  // on failure attach closes the fd
         return;
 
       } else {  // 'B' or unknown -> close
@@ -1834,6 +2257,10 @@ class ParameterServer {
   int live_members_ = 0;
   int64_t sparse_rows_pulled_ = 0, sparse_rows_committed_ = 0;
   int64_t sparse_wire_saved_ = 0;
+  int64_t repl_sparse_bytes_ = 0, repl_sparse_saved_ = 0;
+  std::vector<std::vector<float>> sparse_touch_;  // per table, per row
+  std::vector<int64_t> hot_rows_;                 // per table, decayed est.
+  int64_t touch_folds_ = 0;
   int64_t replicas_attached_ = 0, replica_disconnects_ = 0;
   int64_t merge_batches_ = 0, merged_commits_ = 0, max_merge_batch_ = 0;
   int64_t backpressure_hints_ = 0;
@@ -1935,6 +2362,24 @@ int dk_ps_commit_ctx(void* ps, const float* flat, int64_t last_pull_clock,
   return static_cast<ParameterServer*>(ps)->commit_direct(flat,
                                                           last_pull_clock,
                                                           worker);
+}
+// sparse direct pair (ISSUE 15): the S/V/U exchanges minus the frame —
+// ids/counts concatenate each sparse table's sorted-unique row ids in
+// sparse-leaf order; values concatenate per-leaf payloads in template
+// order (dense whole, sparse [k, dim]).  GIL released by ctypes.
+int64_t dk_ps_pull_sparse(void* ps, const int64_t* ids, const int64_t* counts,
+                          float* out) {
+  return static_cast<ParameterServer*>(ps)->pull_sparse_direct(ids, counts,
+                                                               out);
+}
+int dk_ps_commit_sparse(void* ps, const float* vals, const int64_t* ids,
+                        const int64_t* counts, int64_t last_pull_clock,
+                        int64_t worker) {
+  return static_cast<ParameterServer*>(ps)->commit_sparse_direct(
+      vals, ids, counts, last_pull_clock, worker);
+}
+void dk_ps_hot_rows(void* ps, int64_t* out) {
+  static_cast<ParameterServer*>(ps)->hot_rows(out);
 }
 void dk_ps_stats(void* ps, int64_t* out) {
   static_cast<ParameterServer*>(ps)->stats(out);

@@ -538,6 +538,55 @@ def test_embedding_acceptance_block_tripwires():
     assert out4["acceptance"]["sparse_wire_ok"] is None
 
 
+def test_embedding_hot_tier_acceptance_tripwires():
+    """The issue-15 tripwire block: replication bytes under 1.1 x the
+    touched-row fraction of the dense-R equivalent, client cache memory
+    scaling with the hot fraction, warm hit rate recorded — with None
+    (not a crash) wherever the hot leg is missing (PR-3 convention)."""
+    out = {
+        "dense": {"exchange_bytes": 100_000_000},
+        "sparse": {"exchange_bytes": 1_000_000, "rows_per_s": 5000.0,
+                   "touched_row_fraction": 0.01},
+        "hot": {"repl_sparse_bytes": 1_000_000,
+                "repl_dense_equiv_bytes": 100_000_000,
+                "touched_row_fraction": 0.01,
+                "cache_memory_ratio": 0.02, "hot_fraction": 0.01,
+                "cache_hit_rate": 0.8},
+    }
+    bench._embedding_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["repl_ratio"] == 0.01
+    assert acc["repl_ratio_bound"] == 0.011
+    assert acc["repl_sparse_ok"] is True
+    assert acc["cache_memory_ok"] is True  # 0.02 <= 4 x 0.01
+    assert acc["cache_hit_ok"] is True
+
+    out2 = dict(out)
+    out2["hot"] = {"repl_sparse_bytes": 2_000_000,
+                   "repl_dense_equiv_bytes": 100_000_000,
+                   "touched_row_fraction": 0.01,
+                   "cache_memory_ratio": 0.2, "hot_fraction": 0.01,
+                   "cache_hit_rate": 0.1}
+    bench._embedding_acceptance(out2)
+    acc2 = out2["acceptance"]
+    assert acc2["repl_sparse_ok"] is False  # 0.02 > 0.011
+    assert acc2["cache_memory_ok"] is False  # 0.2 > 0.04
+    assert acc2["cache_hit_ok"] is False
+
+    out3 = {"dense": {"exchange_bytes": 1},
+            "sparse": {"exchange_bytes": 1},
+            "hot": {"error": "boom"}}  # hot leg degraded, PR-9 legs live
+    bench._embedding_acceptance(out3)
+    acc3 = out3["acceptance"]
+    assert acc3["repl_sparse_ok"] is None
+    assert acc3["cache_memory_ok"] is None
+    assert acc3["cache_hit_ok"] is None
+
+    out4 = {}
+    bench._embedding_acceptance(out4)
+    assert out4["acceptance"]["repl_sparse_ok"] is None
+
+
 @pytest.mark.slow  # ~60-200s of real bench machinery on CPU
 def test_embedding_bench_runs_tiny():
     """End-to-end smoke of the issue-9 leg at toy scale: both legs run,
@@ -555,6 +604,16 @@ def test_embedding_bench_runs_tiny():
     assert out["sparse"]["rows_committed"] > 0
     assert out["acceptance"]["rows_per_s_recorded"] is True
     assert out["acceptance"]["wire_ratio"] is not None
+    # issue-15 hot leg: the standby saw row-delta frames, the bounded
+    # cache was smaller than the table, hits landed (the 1.1x bounds are
+    # asserted at the real shape only — the toy head is not negligible)
+    hot = out["hot"]
+    assert hot["repl_sparse_bytes"] > 0
+    assert hot["repl_sparse_bytes"] < hot["repl_dense_equiv_bytes"]
+    assert hot["cache_bytes"] < hot["full_cache_bytes"]
+    assert hot["cache_hits"] > 0
+    assert out["acceptance"]["cache_memory_ok"] is True
+    assert out["acceptance"]["repl_ratio"] is not None
 
 
 @pytest.mark.slow  # ~60-200s of real bench machinery on CPU

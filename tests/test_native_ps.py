@@ -697,25 +697,39 @@ def test_native_never_synced_standby_refuses_traffic():
 
 # -- guidance + hygiene --------------------------------------------------------
 
-def test_not_implemented_messages_name_exact_combo():
-    """The two remaining NotImplementedError branches name the EXACT flag
-    combination that still requires the Python hub (ISSUE 11 satellite:
-    message accuracy is pinned, not vibes)."""
+def test_sparse_direct_pair_served_by_native_hub():
+    """The FORMER last NotImplementedError combination (sparse +
+    inproc + native) is served since ISSUE 15: the C++ hub's
+    dk_ps_pull_sparse/dk_ps_commit_sparse round-trip row values with the
+    Python hub's exact semantics, and the old guidance raises are gone."""
     ps = _native(mode=MODE_DELTA, sparse_leaves=[0])
-    for method, args in (("pull_sparse_direct", ([np.array([0])],)),
-                         ("commit_sparse_direct", ([], 0))):
-        with pytest.raises(NotImplementedError) as ei:
-            getattr(ps, method)(*args)
-        msg = str(ei.value)
-        assert "sparse_tables" in msg
-        assert "transport='inproc'" in msg
-        assert "native_ps" in msg
-        assert "socket" in msg  # names the supported alternative
+    ps.start()
+    try:
+        ids = np.array([1, 4], np.int64)
+        values, clock = ps.pull_sparse_direct([ids])
+        assert values[0].shape == (2, 3)
+        assert values[1].shape == (4,)
+        grads = np.full((2, 3), 0.5, np.float32)
+        ps.commit_sparse_direct([(ids, grads), np.zeros(4, np.float32)],
+                                clock)
+        v2, c2 = ps.pull_sparse_direct([ids])
+        assert c2 == clock + 1
+        np.testing.assert_array_equal(v2[0], values[0] + grads)
+        # validation parity with the Python hub: bad ids are a loud
+        # ValueError on BOTH directions, never a silent skip
+        with pytest.raises(ValueError):
+            ps.pull_sparse_direct([np.array([4, 1], np.int64)])
+        with pytest.raises(ValueError):
+            ps.commit_sparse_direct(
+                [(np.array([99], np.int64), np.zeros((1, 3), np.float32)),
+                 np.zeros(4, np.float32)], c2)
+    finally:
+        ps.stop()
 
 
-def test_trainer_guard_only_rejects_inproc_sparse_native(toy_dataset):
-    """The five Async* trainers accept every native feature combination
-    except sparse+inproc — the one genuinely unported path."""
+def test_trainer_accepts_every_native_sparse_cell(toy_dataset):
+    """The five Async* trainers accept EVERY native feature combination
+    — the sparse+inproc guard is gone (ISSUE 15)."""
     import distkeras_tpu as dk
     from distkeras_tpu.models.base import Model, ModelSpec
 
@@ -726,10 +740,9 @@ def test_trainer_guard_only_rejects_inproc_sparse_native(toy_dataset):
     dk.AsyncADAG(Model.init(spec, seed=0), loss="categorical_crossentropy",
                  native_ps=True, adaptive=True, health_interval_s=1.0,
                  sparse_tables=(0,))
-    with pytest.raises(ValueError, match="inproc"):
-        dk.AsyncADAG(Model.init(spec, seed=0),
-                     loss="categorical_crossentropy", native_ps=True,
-                     transport="inproc", sparse_tables=(0,))
+    dk.AsyncADAG(Model.init(spec, seed=0),
+                 loss="categorical_crossentropy", native_ps=True,
+                 transport="inproc", sparse_tables=(0,))
 
 
 def test_native_build_is_warning_clean():

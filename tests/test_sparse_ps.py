@@ -740,14 +740,14 @@ def test_sparse_knob_validation():
     from distkeras_tpu.runtime.async_trainer import AsyncADAG
 
     spec = ctr_embedding_spec(8, dim=4, fields=2)
-    # sparse + native over SOCKETS is served since ISSUE 11 — only the
-    # inproc combination still needs the Python hub, and the guard says so
+    # every transport x hub cell composes with sparse_tables since
+    # ISSUE 15 (the C++ hub serves the sparse direct pair too): both
+    # native combinations construct cleanly now
     AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
               native_ps=True, loss="categorical_crossentropy")
-    with pytest.raises(ValueError, match="inproc"):
-        AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
-                  native_ps=True, transport="inproc",
-                  loss="categorical_crossentropy")
+    AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
+              native_ps=True, transport="inproc",
+              loss="categorical_crossentropy")
     with pytest.raises(ValueError, match="inproc"):
         tr = AsyncADAG(Model.init(spec, seed=0), sparse_tables="auto",
                        transport="inproc", num_shards=2,
