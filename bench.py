@@ -1324,6 +1324,7 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             "transport": extra.get("transport", "socket"),
             "pipeline": extra.get("pipeline", True),
             "num_shards": extra.get("num_shards", 1),
+            "recv_batch_depth": extra.get("recv_batch_depth", 0),
             # final-loss parity evidence: pipelined pulls see the center one
             # commit earlier (self-staleness 1), so the issue-3 acceptance
             # records where every leg's trajectory LANDS, not just its speed
@@ -1381,6 +1382,20 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
                           "max": staleness.get("max"),
                           "buckets": staleness.get("buckets")},
         }
+        # zero-copy transport evidence (ISSUE 18): frames that crossed
+        # shm rings, ring-full backpressure parks, and the hub's frames-
+        # per-blocking-fill distribution — the batch tripwire's input
+        counters = snap.get("counters", {})
+        if counters.get("ps.shm_frames_total"):
+            out[name]["decomposition"]["shm_frames_total"] = \
+                counters.get("ps.shm_frames_total")
+            out[name]["decomposition"]["shm_ring_full_waits"] = \
+                counters.get("ps.shm_ring_full_waits", 0.0)
+        depth = hists.get("ps_recv_batch_depth")
+        if depth:
+            out[name]["decomposition"]["recv_batch_depth"] = {
+                "count": depth.get("count"), "mean": depth.get("mean"),
+                "max": depth.get("max")}
 
     # transport/hub/compression dimensions on the SAME workload: python hub
     # pipelined sockets (baseline-continuity key), the inproc transport, the
@@ -1395,15 +1410,22 @@ def _bench_async(*, workers: int = 2, window: int = 8, batch: int = 256,
             ("async_adag_native", AsyncADAG, {"native_ps": True}),
             ("async_adag_int8", AsyncADAG, {"compress_commits": "int8"}),
             ("async_adag_shards4", AsyncADAG, {"num_shards": 4}),
+            # zero-copy transport (ISSUE 18): frames over shm rings (same
+            # bytes, no socket) and batched socket receives (recvmmsg)
+            ("shm_ring", AsyncADAG, {"transport": "shm"}),
+            ("recv_batch", AsyncADAG, {"recv_batch_depth": 8}),
             ("async_aeasgd", AsyncAEASGD, {"rho": 2.0})):
         try:
             async_leg(name, cls, extra)
         except Exception as ex:
             out[name] = {"error": f"{type(ex).__name__}: {ex}"}
 
-    # per-transport decomposition (socket vs inproc), on the headline config
+    # per-transport decomposition (socket vs inproc vs shm vs batched),
+    # on the headline config
     for name, extra in (("async_adag", {}),
-                        ("async_adag_inproc", {"transport": "inproc"})):
+                        ("async_adag_inproc", {"transport": "inproc"}),
+                        ("shm_ring", {"transport": "shm"}),
+                        ("recv_batch", {"recv_batch_depth": 8})):
         if isinstance(out.get(name), dict) and "error" not in out[name]:
             try:
                 decomposition_leg(name, AsyncADAG, extra)
@@ -1692,7 +1714,30 @@ def _async_acceptance(out: dict) -> None:
         parity = {"pipelined": fl_p, "serial": fl_s,
                   "abs_diff": (None if fl_p is None or fl_s is None
                                else round(abs(fl_p - fl_s), 6))}
+    # zero-copy transport tripwires (ISSUE 18), None-degrading like the
+    # rest: the shm-ring leg must beat the inproc direct pair on
+    # per-window wall (rings remove the socket from the same-host path;
+    # if they cannot beat even the in-process direct transport's
+    # lock-serialized exchange, the ring is overhead, not a fast path),
+    # and the recv_batch leg's hub must actually have served >1 frame
+    # per blocking fill (else the depth knob bought no syscalls)
+    shm_vs_inproc = None
+    shm_beats = None
+    if _ok("shm_ring") and _ok("async_adag_inproc"):
+        shm_vs_inproc = round(
+            out["shm_ring"]["per_window_wall_ms"]
+            / out["async_adag_inproc"]["per_window_wall_ms"], 4)
+        shm_beats = bool(shm_vs_inproc <= 1.0)
+    batch_ok = None
+    if _ok("recv_batch"):
+        depth = ((out["recv_batch"].get("decomposition") or {})
+                 .get("recv_batch_depth") or {})
+        if depth.get("count"):
+            batch_ok = bool((depth.get("max") or 0) > 1)
     out["acceptance"] = {
+        "shm_vs_inproc_per_window": shm_vs_inproc,
+        "shm_beats_inproc_direct_ok": shm_beats,
+        "batch_syscalls_ok": batch_ok,
         "adag_vs_sync_target": 0.85,
         "adag_vs_sync_ok": (bool(out["adag_vs_sync"] >= 0.85)
                             if "adag_vs_sync" in out else None),

@@ -156,6 +156,35 @@ GUARDED_BY: Dict[str, Tuple[Optional[str], str]] = {
         "one codec per connection/direction owner by documented contract "
         "— instances are thread-confined even though the CLASS is "
         "reachable from many thread roots")),
+    # -- zero-copy transport (ISSUE 18): the shm ring is SPSC by
+    #    construction — ownership is split per COUNTER, not per object,
+    #    so the contract lives here rather than in a lock
+    "ShmFrameRing._q": (None, (
+        "SPSC ring counters behind this view are split-owned: the head "
+        "word (_SHM_Q_HEAD) is written only by the producer role and "
+        "the tail word (_SHM_Q_TAIL) only by the consumer, each "
+        "published after its payload copy so the peer never observes "
+        "torn bytes; the attribute itself is rebound (to None) only in "
+        "close()/_release() by that same single owner")),
+    "ShmFrameRing._i": (None, (
+        "closed-flag words: one-way latches raised by the owning role "
+        "in close() or by either side in mark_closed() for shutdown "
+        "wakeup; peers re-check every park iteration, so the worst "
+        "cost of a stale read is one extra spin")),
+    "ShmFrameRing._data": (None, (
+        "payload bytes are handed off by the head/tail ticket protocol "
+        "in ShmFrameRing._q: the producer only writes free space below "
+        "tail+capacity and publishes head AFTER the copy, the consumer "
+        "only reads below head — the two sides never touch the same "
+        "byte range concurrently")),
+    "ShmEndpoint._timeout": (None, (
+        "GIL-atomic float/None rebinding mirroring socket.settimeout "
+        "semantics; endpoint use is already serialized by the owning "
+        "connection (PSClient._io_lock / one hub handler thread) and a "
+        "stale timeout for one operation is benign")),
+    "SocketParameterServer._conns": ("SocketParameterServer._conn_lock", ""),
+    "SocketParameterServer._shm_seq":
+        ("SocketParameterServer._conn_lock", ""),
     # -- punchcard daemon
     "Punchcard._jobs": ("Punchcard._lock", ""),
     "Punchcard._lock_path": ("Punchcard._lock", ""),

@@ -292,8 +292,17 @@ def stress(duration: float = 2.0, workers: int = 4,
         AdaptiveRateController, DeltaParameterServer, HubSnapshotter,
         PSClient, ReplicationFeed, SocketParameterServer, _AdaptiveCombiner)
 
+    import shutil
+    import tempfile
+
     templates = [np.zeros((8, 4), np.float32), np.zeros((16, 4), np.float32)]
     health_mod.reset_default()
+    # shm rings under lockset instrumentation too (ISSUE 18): worker 0
+    # attaches via the 'Z' handshake so the ring write/read paths and the
+    # hub-side connection swap run alongside the TCP traffic
+    shm_dir = tempfile.mkdtemp(
+        prefix="dklockset-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
     with instrument(SocketParameterServer, DeltaParameterServer,
                     ReplicationFeed, _AdaptiveCombiner,
                     AdaptiveRateController, HubSnapshotter,
@@ -301,7 +310,8 @@ def stress(duration: float = 2.0, workers: int = 4,
         hub = DeltaParameterServer([t.copy() for t in templates],
                                    host="127.0.0.1", port=0,
                                    idle_timeout=None,
-                                   sparse_leaves=(1,), adaptive=True)
+                                   sparse_leaves=(1,), adaptive=True,
+                                   shm_dir=shm_dir)
         hub.start()
         standby = DeltaParameterServer([t.copy() for t in templates],
                                        host="127.0.0.1", port=0,
@@ -316,7 +326,8 @@ def stress(duration: float = 2.0, workers: int = 4,
             try:
                 cli = PSClient("127.0.0.1", hub.port, templates,
                                timeout=10.0, max_reconnects=2,
-                               sparse_leaves=(1,), adaptive=(i % 2 == 0))
+                               sparse_leaves=(1,), adaptive=(i % 2 == 0),
+                               shm=(i == 0))
                 delta = [np.full_like(t, 1e-3) for t in templates]
                 step = 0
                 while not stop.is_set():
@@ -355,6 +366,7 @@ def stress(duration: float = 2.0, workers: int = 4,
             t.join(timeout=10)
         standby.stop()
         hub.stop()
+        shutil.rmtree(shm_dir, ignore_errors=True)
         if errors:
             chk.findings.append(Finding(
                 RULE, "distkeras_tpu/analysis/lockset.py", 1,

@@ -69,6 +69,10 @@ REQUESTS: Dict[str, Optional[str]] = {
     "ACTION_RECONNECT": "ACTION_RETRY",
     "ACTION_BYE": None,
     "ACTION_REPL": "ACTION_REPL",
+    # shm attach (ISSUE 18): the client's Z request; the hub replies Z
+    # with the ring paths (or an empty decline).  The full three-step
+    # never-torn handshake lives in :data:`SHM_RULES`/:func:`explore_shm`
+    "ACTION_SHM": "ACTION_SHM",
 }
 
 #: Actions that advance the hub's commit clock when served.
@@ -87,6 +91,7 @@ REPLY_PRODUCERS: Dict[str, Tuple[str, ...]] = {
     "ACTION_TRACE": ("encode_time_payload",),
     "ACTION_RETRY": ("encode_retry_payload",),
     "ACTION_REPL": ("ReplicationFeed", "attach"),
+    "ACTION_SHM": ("ACTION_SHM",),
 }
 
 #: The standby/promotion contract (ISSUE 7 semantics) as checkable
@@ -111,6 +116,39 @@ STANDBY_RULES: Dict[str, Any] = {
     # a legacy standby keeps receiving the dense-materialized delta
     # stream — never a frame kind it cannot parse (a torn stream)
     "sparse_delta_requires_cap": True,
+}
+
+#: The shm attach/decline/detach contract (ISSUE 18) as checkable flags.
+#: The handshake is three TCP frames — client ``Z`` request, hub ``Z``
+#: reply (ring paths, or an empty decline), client ``Z`` confirm
+#: (mapped / abort) — and only after a positive confirm does EITHER end
+#: leave the socket for the ring.  Because TCP is FIFO and the client
+#: sends nothing on the socket after a positive confirm, the switch
+#: point is totally ordered on both ends: there is never a frame in
+#: flight on the transport the peer is not reading.  Fixture tests flip
+#: these to seed torn-attach / dead-ring-peer violations.
+SHM_RULES: Dict[str, Any] = {
+    # the hub's Z reply (offer or decline) travels on the SOCKET — a hub
+    # that jumps to the ring before replying strands the client, which
+    # is still parked in recv() on TCP
+    "reply_before_switch": True,
+    # the hub switches to the ring only after the client's positive
+    # confirm frame — an offer the client failed to mmap must leave the
+    # hub serving TCP
+    "switch_requires_confirm": True,
+    # a declined attach (hub not shm-capable / no shm_dir) leaves both
+    # ends on TCP, byte-identical to a legacy session
+    "decline_keeps_tcp": True,
+    # a client-side mmap failure aborts the attach (confirm=0); both
+    # ends stay on TCP
+    "abort_keeps_tcp": True,
+    # a legacy hub drops the connection on the unknown Z byte; the
+    # client treats that exactly like a decline and redials plain TCP
+    "legacy_close_is_decline": True,
+    # closing/severing either attached end marks BOTH rings closed so a
+    # peer parked in the busy-then-wait read loop wakes and errors out
+    # instead of spinning against a dead producer forever
+    "sever_wakes_ring_peer": True,
 }
 
 
@@ -463,6 +501,139 @@ def _explore_standby_cap(rules: Dict[str, Any], sparse_cap: bool,
     return findings
 
 
+# -- bounded exploration: shm attach / decline / detach ------------------------
+
+def explore_shm(rules: Optional[Dict[str, Any]] = None) -> List[Finding]:
+    """Exhaustive walk of the shm attach handshake (ISSUE 18) across all
+    three hub generations — shm-capable, capable-but-declining, and
+    legacy (drops the unknown ``Z`` byte) — and both client mmap
+    outcomes.  Checks:
+
+    - **torn-attach**: after the handshake settles, a data exchange with
+      the two ends on different transports (one writing the ring the
+      other never reads, or writing a socket the other abandoned);
+    - **stranded-reply**: the hub's offer/decline sent on the ring
+      before the client mapped it (the client is parked in TCP recv);
+    - **dead-ring-peer**: a severed attached session whose surviving
+      end never wakes from the ring park loop;
+    - deadlock freedom: every explored path reaches a settled state.
+    """
+    rules = dict(SHM_RULES if rules is None else rules)
+    findings: List[Finding] = []
+    for hub_gen in ("capable", "declining", "legacy"):
+        findings.extend(_explore_shm_gen(rules, hub_gen))
+        if len(findings) >= 8:
+            break
+    return findings
+
+
+def _explore_shm_gen(rules: Dict[str, Any], hub_gen: str) -> List[Finding]:
+    findings: List[Finding] = []
+    # state: (phase, client_tr, hub_tr); transports are "tcp" | "shm";
+    # phases walk idle -> requested -> offered -> confirmed -> settled
+    # (decline/abort/legacy-close settle early).  hub_gen is immutable
+    # per walk, so it parameterizes the exploration like sparse_cap does
+    # for the standby machine.
+    init = ("idle", "tcp", "tcp")
+    seen = {init}
+    frontier: List[Tuple[Tuple[str, str, str], Tuple[str, ...]]] = [(init, ())]
+    settled_reachable = False
+    while frontier:
+        state, trace = frontier.pop()
+        phase, client_tr, hub_tr = state
+        events: List[Tuple[str, Tuple[str, str, str]]] = []
+        if phase == "idle":
+            events.append(("client_sends_Z", ("requested", client_tr, hub_tr)))
+        elif phase == "requested":
+            if hub_gen == "capable":
+                # a hub violating reply_before_switch moves to the ring
+                # BEFORE its offer frame leaves — the offer then travels
+                # on a ring the client has not mapped
+                offer_hub_tr = hub_tr if rules["reply_before_switch"] else "shm"
+                if offer_hub_tr == "shm" and client_tr == "tcp":
+                    findings.append(Finding(
+                        "protocol", SELF_PATH, 1,
+                        f"stranded-reply: the hub's Z offer is sent on the "
+                        f"ring before the client mapped it — the client is "
+                        f"parked in TCP recv forever "
+                        f"(trace: {' -> '.join(trace + ('hub_offers',))})"))
+                else:
+                    # a hub violating switch_requires_confirm flips to
+                    # the ring at offer time instead of waiting for the
+                    # client's mapped-confirm
+                    post = offer_hub_tr if rules["switch_requires_confirm"] \
+                        else "shm"
+                    events.append(("hub_offers", ("offered", client_tr, post)))
+            elif hub_gen == "declining":
+                post = "tcp" if rules["decline_keeps_tcp"] else "shm"
+                events.append(("hub_declines", ("settled", client_tr, post)))
+            else:  # legacy: unknown action byte -> connection dropped
+                if rules["legacy_close_is_decline"]:
+                    events.append(("client_redials_tcp",
+                                   ("settled", "tcp", "tcp")))
+                else:
+                    findings.append(Finding(
+                        "protocol", SELF_PATH, 1,
+                        f"torn-attach: a legacy hub dropped the Z request "
+                        f"and the client neither redials nor degrades — "
+                        f"the session is dead "
+                        f"(trace: {' -> '.join(trace + ('legacy_close',))})"))
+        elif phase == "offered":
+            # client maps the rings and sends confirm=1, then moves to
+            # the ring itself (it sends nothing further on the socket)
+            events.append(("client_mmap_ok", ("confirmed", "shm", hub_tr)))
+            abort_hub_tr = "tcp" if rules["abort_keeps_tcp"] else "shm"
+            events.append(("client_mmap_fail",
+                           ("settled", "tcp",
+                            abort_hub_tr if rules["switch_requires_confirm"]
+                            else hub_tr)))
+        elif phase == "confirmed":
+            # the hub consumes the confirm frame (FIFO: it is the last
+            # TCP frame this client ever sends) and switches
+            post = "shm" if rules["switch_requires_confirm"] else hub_tr
+            events.append(("hub_receives_confirm", ("settled", client_tr,
+                                                    post)))
+        elif phase == "settled":
+            settled_reachable = True
+            if client_tr != hub_tr:
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"torn-attach: handshake settled with client on "
+                    f"{client_tr} and hub on {hub_tr} — every subsequent "
+                    f"frame is written to a transport the peer never reads "
+                    f"(trace: {' -> '.join(trace)})"))
+                continue
+            if client_tr == "shm":
+                # detach: either end severs; the ring closed flags must
+                # wake the surviving end's park loop
+                if not rules["sever_wakes_ring_peer"]:
+                    findings.append(Finding(
+                        "protocol", SELF_PATH, 1,
+                        f"dead-ring-peer: an attached end died but the "
+                        f"surviving peer's ring park loop is never woken "
+                        f"(no closed-flag publication) "
+                        f"(trace: {' -> '.join(trace + ('peer_severs',))})"))
+            continue  # settled states are final
+        if not events and phase != "settled" and not findings:
+            findings.append(Finding(
+                "protocol", SELF_PATH, 1,
+                f"shm-attach deadlock: no event enabled in phase {phase} "
+                f"under hub generation {hub_gen} "
+                f"(trace: {' -> '.join(trace[-6:])})"))
+        for name, nstate in events:
+            if nstate not in seen:
+                seen.add(nstate)
+                frontier.append((nstate, trace + (name,)))
+        if len(findings) >= 8:
+            return findings
+    if not settled_reachable and not findings:
+        findings.append(Finding(
+            "protocol", SELF_PATH, 1,
+            f"shm-attach unreachable-settle: no interleaving under hub "
+            f"generation {hub_gen} ever settles the handshake"))
+    return findings
+
+
 # -- the pass ------------------------------------------------------------------
 
 def check(net_src: SourceFile, ps_src: SourceFile, root: str,
@@ -473,6 +644,7 @@ def check(net_src: SourceFile, ps_src: SourceFile, root: str,
     # the same run that introduced it
     findings.extend(explore_sessions())
     findings.extend(explore_standby())
+    findings.extend(explore_shm())
     return apply_annotations(findings, sources or {}, root, rule="protocol")
 
 

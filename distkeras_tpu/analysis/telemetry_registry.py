@@ -63,6 +63,10 @@ TELEMETRY_NAMES = frozenset({
     # -- transport -------------------------------------------------------------
     "net_tx_frames_total", "net_tx_bytes_total",
     "net_rx_frames_total", "net_rx_bytes_total",
+    # zero-copy shm transport + batched receive (ISSUE 18): frames moved
+    # over shared-memory rings, producer parks on a full ring, and the
+    # frames-per-syscall-batch histogram of the hub's batched receive
+    "ps.shm_frames_total", "ps.shm_ring_full_waits", "ps_recv_batch_depth",
     # -- trainer / engine / data planes ----------------------------------------
     "trainer_epochs_total", "trainer_epoch_seconds",
     "trainer_samples_total", "trainer_samples_per_sec_per_chip",

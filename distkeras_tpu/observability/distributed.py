@@ -522,6 +522,9 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
             hot = _hot_tier_block(live_snap)
             if hot is not None:
                 report.setdefault("sparse", {})["hot_tier"] = hot
+            transport = _transport_block(live_snap)
+            if transport is not None:
+                report["transport"] = transport
     report["coverage"] = _report_coverage(
         len(spans), window_spans, commits_total, commits_with_ctx,
         workers, live_snap)
@@ -582,6 +585,27 @@ def _hot_tier_block(live_snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     if not seen:
         return None
     return {"cache": rates, "repl_sparse_bytes_total": round(repl_bytes)}
+
+
+def _transport_block(live_snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """ISSUE 18: which transport each worker's PS client is riding —
+    ``"shm"`` (shared-memory frame rings), ``"tcp"``, ``"inproc"``, or
+    ``"mixed"`` (a sharded client whose shards negotiated differently)
+    — plus a fleet-level tally, from the ``transport`` meta the health
+    reports carry.  ``None`` when no worker reports one, so pre-ISSUE-18
+    reports stay byte-identical."""
+    workers = live_snap.get("workers") or {}
+    by_worker: Dict[str, str] = {}
+    for w, entry in workers.items():
+        t = (entry.get("meta") or {}).get("transport")
+        if t is not None:
+            by_worker[w] = str(t)
+    if not by_worker:
+        return None
+    counts: Dict[str, int] = {}
+    for t in by_worker.values():
+        counts[t] = counts.get(t, 0) + 1
+    return {"workers": by_worker, "counts": counts}
 
 
 def _report_coverage(n_spans: int, window_spans: int, commits_total: int,

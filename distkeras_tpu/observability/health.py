@@ -147,6 +147,11 @@ class HealthCollector:
                     meta["last_wall"] = float(report["t_wall"])
                 except (TypeError, ValueError):
                     pass
+            # which transport the worker's PS client is riding ("tcp",
+            # "shm", "inproc", "mixed") — surfaced as distkeras-top's
+            # TRANS column and fleet_report's transport block (ISSUE 18)
+            if report.get("transport") is not None:
+                meta["transport"] = str(report["transport"])
             series = [(self._series_for(entry, name), value)
                       for name, value in items]
         for s, value in series:
@@ -646,9 +651,10 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
     lines = [
         f"distkeras-top — {len(workers)} worker(s), "
         f"{len(events)} event(s)  [{time.strftime('%H:%M:%S')}]",
-        f"{'WORKER':>8} {'SHARD':>5} {'WIN/S':>7} {'WALL MS':>9} "
-        f"{'P95 MS':>9} {'STALE':>6} {'SCALE':>6} {'RECON':>6} "
-        f"{'ROW/S':>8} {'HIT%':>5} {'RΔ/S':>8} {'MQ':>4} {'AGE S':>6}",
+        f"{'WORKER':>8} {'SHARD':>5} {'TRANS':>6} {'WIN/S':>7} "
+        f"{'WALL MS':>9} {'P95 MS':>9} {'STALE':>6} {'SCALE':>6} "
+        f"{'RECON':>6} {'ROW/S':>8} {'HIT%':>5} {'RΔ/S':>8} {'MQ':>4} "
+        f"{'AGE S':>6}",
     ]
 
     def sort_key(item):
@@ -685,6 +691,11 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
         repl = m.get("repl_sparse_bytes_total") or {}
         lines.append(
             f"{w:>8} {_fmt(meta.get('shard')):>5} "
+            # TRANS (ISSUE 18): the worker's PS transport — "shm" rows
+            # are riding shared-memory rings, "tcp" plain sockets,
+            # "inproc" the direct in-process path, "mixed" a sharded
+            # client whose shards negotiated differently
+            f"{_fmt(meta.get('transport')):>6} "
             f"{_fmt(windows.get('rate'), 2):>7} "
             f"{_fmt(wall.get('mean')):>9} {_fmt(wall.get('p95')):>9} "
             f"{_fmt(stale.get('last'), 0):>6} "

@@ -45,6 +45,8 @@ finish.
 from __future__ import annotations
 
 import os
+import shutil
+import tempfile
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -117,6 +119,7 @@ class AsyncDistributedTrainer(Trainer):
                  compress_commits: Optional[str] = None,
                  transport: str = "socket",
                  num_shards: int = 1,
+                 recv_batch_depth: int = 0,
                  pipeline: bool = True,
                  max_inflight_commits: int = 2,
                  max_reconnects: Optional[int] = None,
@@ -140,9 +143,16 @@ class AsyncDistributedTrainer(Trainer):
         # pull_direct/commit_direct under its lock — no sockets, no
         # framing; identical training trajectories (the parity property
         # tests/test_transport.py pins).  Requires owning the hub.
-        if transport not in ("socket", "inproc"):
-            raise ValueError(f"transport must be 'socket' or 'inproc', "
-                             f"got {transport!r}")
+        # transport="shm" (ISSUE 18): the socket path plus the opt-in
+        # shared-memory attach — the hub gets an shm_dir, every worker
+        # client sends the action-Z capability request, and same-host
+        # frames move over mmap rings instead of the kernel socket stack.
+        # Byte-identical frame payloads, so trajectories match "socket"
+        # exactly; a hub that declines (or a legacy hub) degrades each
+        # worker independently back to plain TCP.
+        if transport not in ("socket", "inproc", "shm"):
+            raise ValueError(f"transport must be 'socket', 'inproc' or "
+                             f"'shm', got {transport!r}")
         if transport == "inproc" and ps_address is not None:
             raise ValueError(
                 "transport='inproc' requires a co-located hub (the trainer "
@@ -173,6 +183,19 @@ class AsyncDistributedTrainer(Trainer):
         self.num_shards = int(num_shards)
         if self.num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        # batched hub receive (ISSUE 18): > 0 makes every trainer-owned
+        # hub drain its sockets recvmmsg-style, up to this many frames per
+        # syscall (falling back to plain nonblocking recvs where the
+        # batched syscall is unavailable).  0 (default) keeps the exact
+        # one-recv_into-per-frame receive loop
+        self.recv_batch_depth = int(recv_batch_depth)
+        if self.recv_batch_depth < 0:
+            raise ValueError(f"recv_batch_depth must be >= 0, got "
+                             f"{recv_batch_depth}")
+        # lazily-created run-scoped directory the shm transport's ring
+        # files live in (under /dev/shm when present, so the "file" is
+        # pure page cache); cleaned up when the trainer-owned hub stops
+        self._shm_dir: Optional[str] = None
         # worker-only mode (multi-host): connect to an external hub at this
         # (host, port) — or, sharded, a SEQUENCE of per-shard (host, port)
         # pairs aligned with the shard plan (num_shards defaults to the
@@ -396,7 +419,33 @@ class AsyncDistributedTrainer(Trainer):
             # only added when on, so the off path's zero-adaptive-
             # machinery guarantee holds for either hub implementation
             kw["adaptive"] = True
+        if self.transport == "shm":
+            # only added when opted in, so "socket"/"inproc" runs
+            # construct hubs with byte-identical kwargs to pre-shm code
+            kw["shm_dir"] = self._ensure_shm_dir()
+        if self.recv_batch_depth > 0:
+            kw["recv_batch_depth"] = self.recv_batch_depth
         return kw
+
+    def _ensure_shm_dir(self) -> str:
+        """The run's ring-file directory, created on first use.  Prefers
+        ``/dev/shm`` (tmpfs: ring pages never touch a disk) and falls
+        back to the default temp dir — mmap over any filesystem is
+        correct, tmpfs is just faster under memory pressure."""
+        if self._shm_dir is None:
+            self._shm_dir = tempfile.mkdtemp(
+                prefix="dkshm-",
+                dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+        return self._shm_dir
+
+    def _cleanup_shm_dir(self) -> None:
+        """Remove the run's ring-file directory (idempotent).  The hub
+        unlinks each ring file right after its attach handshake — live
+        mappings keep the memory alive — so this normally removes an
+        empty directory; leftovers only exist if a hub died mid-attach."""
+        if self._shm_dir is not None:
+            shutil.rmtree(self._shm_dir, ignore_errors=True)
+            self._shm_dir = None
 
     def _resolve_sparse_tables(self, flat: List[np.ndarray]) -> Tuple[int, ...]:
         """The run's sparse leaf indices: () when off, the spec's declared
@@ -613,6 +662,7 @@ class AsyncDistributedTrainer(Trainer):
                 # silently degrade into training from seed)
                 if not ps.wait_synced(timeout=self.replica_sync_timeout):
                     ps.stop()
+                    self._cleanup_shm_dir()
                     raise RuntimeError(
                         f"replica_of={self.replica_of}: no full sync "
                         f"arrived from the primary within "
@@ -731,7 +781,8 @@ class AsyncDistributedTrainer(Trainer):
                                          trace_context=ctx,
                                          failover=self._ps_failover,
                                          sparse_leaves=sparse_idx,
-                                         adaptive=self.adaptive)
+                                         adaptive=self.adaptive,
+                                         shm=self.transport == "shm")
             else:
                 client = PSClient(addresses[0][0], addresses[0][1],
                                   templates=flat0,
@@ -745,7 +796,8 @@ class AsyncDistributedTrainer(Trainer):
                                             if self._ps_failover else ()),
                                   sparse_leaves=sparse_idx,
                                   adaptive=self.adaptive,
-                                  sparse_cache_rows=self.sparse_cache_rows)
+                                  sparse_cache_rows=self.sparse_cache_rows,
+                                  shm=self.transport == "shm")
             pipeline = self.pipeline
             # row-sparse exchange (ISSUE 9): each window's pull/commit
             # carries the sorted-unique row ids its batches touch.
@@ -806,6 +858,12 @@ class AsyncDistributedTrainer(Trainer):
                 client.report_health({
                     "job": trace_job or "local", "worker": idx,
                     "seq": h_seq, "t_wall": time.time(),
+                    # which transport this worker's frames actually move
+                    # over ("shm" only after a successful attach — a
+                    # declined attach honestly reports "tcp"); the TRANS
+                    # column in distkeras-top and fleet_report's
+                    # transport block read this
+                    "transport": getattr(client, "transport", None),
                     "metrics": metrics})
                 h_seq += 1
                 h_wall_ms, h_wall_n = 0.0, 0
@@ -1121,6 +1179,7 @@ class AsyncDistributedTrainer(Trainer):
                 errors.append(snap_err)  # recorded in worker_errors below
         if ps is not None:
             ps.stop()
+        self._cleanup_shm_dir()
         self.worker_restarts = sum(restart_counts)
         self.worker_errors = list(errors)
         if errors and self.on_worker_failure == "raise":

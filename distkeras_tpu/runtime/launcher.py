@@ -105,7 +105,9 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
                            replica_of: Optional[Any] = None,
                            health_jsonl: Optional[str] = None,
                            sparse_tables: Optional[Any] = None,
-                           adaptive: bool = False) -> Any:
+                           adaptive: bool = False,
+                           shm_dir: Optional[str] = None,
+                           recv_batch_depth: int = 0) -> Any:
     """Start a standalone PS hub serving ``model``'s weights (head-node side
     of the async multi-host topology).  Returns the started server; read
     ``.port``, stop with ``.stop()``, final weights via ``.get_weights()``.
@@ -169,6 +171,15 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
     iterable names flat-leaf indices explicitly.  Both ends derive the
     same leaf set (and, sharded, the same row-range plan) from the same
     model — nothing travels on the wire.  Served by BOTH hubs.
+
+    Zero-copy transport (ISSUE 18): ``shm_dir`` lets same-host workers
+    that dialed with ``shm=True`` attach a pair of mmap-backed frame
+    rings (wire action ``Z``) and move the SAME frame bytes without the
+    kernel TCP stack; unset, every attach is declined and clients ride
+    TCP unchanged.  ``recv_batch_depth=N`` drains up to N queued frames
+    per receive-loop wakeup (recvmmsg where available).  Served by BOTH
+    hubs (the C++ hub's wakeup loop already drains its buffer; the knob
+    is accepted for parity).
     """
     from distkeras_tpu.runtime.parameter_server import (
         ShardedParameterServer, shard_plan)
@@ -207,7 +218,8 @@ def start_parameter_server(model: Any, mode: str = "delta", num_workers: int = 1
         common = dict(idle_timeout=idle_timeout, snapshot_dir=shard_snap,
                       snapshot_interval=snapshot_interval,
                       restore=restore if own_snapshots else False,
-                      shard_id=shard_id)
+                      shard_id=shard_id, shm_dir=shm_dir,
+                      recv_batch_depth=recv_batch_depth)
         if hub_sparse:
             common["sparse_leaves"] = hub_sparse
         if native:
@@ -327,6 +339,17 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "list of flat-leaf indices; workers started "
                              "with the matching sparse_tables knob then "
                              "exchange only the rows each batch touches")
+    parser.add_argument("--shm-dir", default=None, metavar="DIR",
+                        help="serve shared-memory frame-ring attaches (wire "
+                             "action Z) to same-host clients dialed with "
+                             "shm=True, creating ring files under DIR "
+                             "(ideally tmpfs, e.g. /dev/shm); omit to "
+                             "decline every attach (clients ride TCP "
+                             "unchanged)")
+    parser.add_argument("--recv-batch-depth", type=int, default=0,
+                        help="drain up to N queued frames per receive-loop "
+                             "wakeup (recvmmsg where available); 0 = one "
+                             "recv per frame, today's loop")
     parser.add_argument("--adaptive", action="store_true",
                         help="telemetry-driven adaptive aggregation (both "
                              "hubs): merge queued commits "
@@ -388,7 +411,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                                 replica_of=replica_of,
                                 health_jsonl=args.health_jsonl,
                                 sparse_tables=sparse_tables,
-                                adaptive=args.adaptive)
+                                adaptive=args.adaptive,
+                                shm_dir=args.shm_dir,
+                                recv_batch_depth=args.recv_batch_depth)
     if replica_of is not None:
         print(f"ps standby (replica of {replica_of[0]}:{replica_of[1]}) "
               f"listening on {args.host}:{ps.port}", flush=True)

@@ -180,6 +180,22 @@ def _bind_ps(lib: ctypes.CDLL) -> None:
     lib.dk_ps_time_ns.restype = ctypes.c_int64
     lib.dk_ps_time_ns.argtypes = [ctypes.c_void_p]
     lib.dk_ps_destroy.argtypes = [ctypes.c_void_p]
+    # shm transport (ISSUE 18): hub-side attach enable + standalone ring
+    # handles (the cross-language layout pin drives these directly)
+    lib.dk_ps_shm_attach.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dk_shm_ring_create.restype = ctypes.c_void_p
+    lib.dk_shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_uint64]
+    lib.dk_shm_ring_open.restype = ctypes.c_void_p
+    lib.dk_shm_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dk_shm_ring_write.restype = ctypes.c_longlong
+    lib.dk_shm_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_longlong, ctypes.c_int]
+    lib.dk_shm_ring_read.restype = ctypes.c_longlong
+    lib.dk_shm_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_longlong, ctypes.c_int]
+    lib.dk_shm_ring_close.argtypes = [ctypes.c_void_p]
+    lib.dk_shm_ring_destroy.argtypes = [ctypes.c_void_p]
 
 
 _ps_lib = LazyNativeLib(_SRC, _LIB, _bind_ps)
@@ -237,7 +253,9 @@ class NativeParameterServer:
                  replica_feed_retries: int = 3,
                  replica_feed_backoff: float = 0.2,
                  sparse_leaves: Sequence[int] = (),
-                 adaptive: bool = False):
+                 adaptive: bool = False,
+                 shm_dir: Optional[str] = None,
+                 recv_batch_depth: int = 0):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native PS unavailable: {build_error()}")
@@ -278,6 +296,23 @@ class NativeParameterServer:
                                         int(max_payload))
         if not self._handle:
             raise RuntimeError("dk_ps_create failed")
+        # zero-copy shm transport (ISSUE 18): with a ring directory set,
+        # the C++ hub answers the opt-in 'Z' attach — same-host workers'
+        # frames move over mmap rings byte-identical to the socket stream.
+        # None keeps the hub TCP-only (it declines nothing: the action
+        # never reaches a hub whose clients were not asked to send it,
+        # and an unsolicited 'Z' is declined with an empty offer).
+        self.shm_dir = None if shm_dir is None else str(shm_dir)
+        if self.shm_dir is not None:
+            os.makedirs(self.shm_dir, exist_ok=True)
+            lib.dk_ps_shm_attach(self._handle,
+                                 self.shm_dir.encode("utf-8"))
+        # accepted for hub-kwarg parity with SocketParameterServer: the
+        # C++ receive loop already drains a pipelined client's parked
+        # frames with ONE recv() per wakeup into its grow-once buffer,
+        # which is what the Python hub's BatchedReceiver approximates —
+        # the knob has nothing further to turn natively
+        self.recv_batch_depth = max(0, int(recv_batch_depth))
         if self.replica_of is not None:
             host = self.replica_of[0]
             if host in ("", "0.0.0.0"):

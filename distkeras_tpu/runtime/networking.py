@@ -54,8 +54,11 @@ DCN-bandwidth headroom lever for the genuinely-async PS topology.
 from __future__ import annotations
 
 import json
+import mmap
+import os
 import socket
 import struct
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -173,6 +176,28 @@ ACTION_SPARSE_QCOMMIT = b"X"
 ACTION_RECONNECT = b"G"
 ACTION_RETRY = b"Y"
 
+# shared-memory transport attach (zero-copy same-host path, ISSUE 18): a
+# client constructed with ``shm=True`` sends one ``Z`` request (one blob:
+# u8 version, u64 big-endian ring-capacity hint) right after its optional
+# ``T`` announce; the hub replies with a ``Z`` frame carrying TWO path
+# blobs (client->hub ring file, hub->client ring file) or ZERO blobs (a
+# decline — different host, shm disabled, unsupported version).  On an
+# offer the client mmaps both rings and sends one ``Z`` confirm over TCP
+# (one blob: ``b"\x01"`` attached / ``b"\x00"`` abort); only after the
+# hub reads an attached confirm do BOTH ends switch the very next frame
+# onto the rings — the TCP FIFO makes the switch point exact, so the
+# stream is never torn (``analysis/protocol_model.py`` walks this
+# three-step handshake exhaustively).  The rings carry the SAME framed
+# bytes as the socket, so trajectories are bit-identical and every
+# recording-socket pin keeps holding.  Opt-in like ``T``/``M``/``G``: no
+# Z frame ever moves unless the client asked for shm, so every
+# pre-existing frame stays byte-identical and un-upgraded peers
+# interoperate unchanged; a legacy hub closing on the unknown action
+# reads as a decline and the client redials plain TCP.
+ACTION_SHM = b"Z"
+
+SHM_VERSION = 1  # bumped only if the ring layout changes incompatibly
+
 ROW_ID_DTYPE = np.dtype(np.int64)
 
 
@@ -205,7 +230,7 @@ MAX_SOCKET_BUF = 8 << 20    # cap — beyond one large frame, memory not speed
 
 
 def configure_socket(sock: socket.socket, payload_hint: Optional[int] = None,
-                     nodelay: bool = True) -> None:
+                     nodelay: bool = True, quickack: bool = False) -> None:
     """Hot-path tuning applied to BOTH ends of every PS/client connection.
 
     - ``TCP_NODELAY``: the exchange is strictly request/response, so Nagle
@@ -218,9 +243,22 @@ def configure_socket(sock: socket.socket, payload_hint: Optional[int] = None,
       to compute instead of blocking in ``sendall`` at the default buffer
       size.  Best-effort — the kernel may clamp further.  Without a hint
       the kernel defaults stand (control-plane connections don't need
-      frame-sized buffers)."""
+      frame-sized buffers).
+    - ``TCP_QUICKACK`` (opt-in, Linux-only, best-effort): the hub sets it
+      on accepted connections so its coalesced 13-byte acks leave
+      immediately instead of riding the delayed-ack timer — acks are the
+      one latency-critical tiny send left on the pipelined commit path.
+      Purely a kernel-timing knob: wire BYTES are unchanged (pinned by a
+      recording-socket test), and platforms without the option silently
+      keep delayed acks."""
     if nodelay:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if quickack:
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP,
+                            getattr(socket, "TCP_QUICKACK"), 1)
+        except (AttributeError, OSError):
+            pass  # non-Linux / kernel policy; delayed acks still correct
     if payload_hint is None:
         return
     size = max(MIN_SOCKET_BUF, min(int(payload_hint) + 4096, MAX_SOCKET_BUF))
@@ -833,3 +871,547 @@ def recv_tensors(sock: socket.socket, templates: Optional[Sequence[np.ndarray]] 
     action = _scatter_recv_into(sock, out, memoryview(bytearray(13)),
                                 limit=limit)
     return action, list(out)
+
+
+# -- zero-copy shared-memory transport (action Z, ISSUE 18) -------------------
+#
+# Same-host workers can move the EXACT framed byte stream of a TCP
+# connection through a pair of mmap-backed SPSC byte rings instead of the
+# kernel socket stack.  Each direction gets its own ring file; each ring
+# has exactly one producer and one consumer, so the only shared mutable
+# state is two monotonically increasing byte counters (head: total bytes
+# written, tail: total bytes read) plus two closed flags.  The counters
+# are aligned 8-byte words in the header page, written with single
+# aligned stores (atomic on every platform the repo targets; the C++ hub
+# maps the same offsets as ``std::atomic`` with acquire/release), and
+# each side only ever WRITES its own counter — the classic SPSC ticket
+# protocol, no lock, no futex.  Waits are busy-then-park: a short spin
+# (the common case — the peer is actively draining) escalating to short
+# sleeps, so an idle ring costs no CPU.
+#
+# Ring file layout (native-endian — both ends share the host):
+#
+#     offset    0  u64  magic (SHM_RING_MAGIC — layout version 1)
+#     offset    8  u64  capacity (power of two, data-region bytes)
+#     offset   64  u64  head   — producer-owned, total bytes written
+#     offset  128  u64  tail   — consumer-owned, total bytes read
+#     offset  192  u32  producer_closed
+#     offset  196  u32  consumer_closed
+#     offset 4096  data region (capacity bytes, indexed mod capacity)
+#
+# head/tail live on their own cache lines so producer and consumer never
+# false-share, and the data region starts on a page boundary.
+
+SHM_RING_MAGIC = 0x646B2D72696E6731  # "dk-ring1"
+SHM_RING_HEADER = 4096
+SHM_RING_DEFAULT_CAPACITY = 1 << 20
+# u64-index offsets into the header page (memoryview cast "Q")
+_SHM_Q_MAGIC = 0
+_SHM_Q_CAPACITY = 1
+_SHM_Q_HEAD = 8      # byte 64
+_SHM_Q_TAIL = 16     # byte 128
+# u32-index offsets (memoryview cast "I")
+_SHM_I_PRODUCER_CLOSED = 48  # byte 192
+_SHM_I_CONSUMER_CLOSED = 49  # byte 196
+
+
+class ShmFrameRing:
+    """One direction of the zero-copy transport: an mmap-backed SPSC byte
+    ring carrying the SAME framed bytes the socket would (so bit-identity
+    with TCP is structural, not re-proven per message).  Exactly one
+    producer and one consumer; this object takes ONE of the two roles.
+
+    ``write``/``read_into`` mirror ``sendall``/``recv_into`` semantics —
+    write moves every byte or raises, read returns whatever contiguous
+    run is available (possibly fewer bytes than asked) and 0 only when
+    the producer closed with the ring drained, so the socket receive
+    helpers treat a dead ring peer exactly like a closed socket.  A full
+    ring parks the producer (counted in ``ps.shm_ring_full_waits``); a
+    deadline overrun raises ``socket.timeout`` so reconnect/heartbeat
+    paths built for sockets keep working unchanged."""
+
+    _SPIN = 200          # busy iterations before the first sleep
+    _PARK_MIN = 10e-6    # first sleep
+    _PARK_MAX = 1e-3     # sleep ceiling while parked
+
+    def __init__(self, path: str, mm: mmap.mmap, role: str):
+        if role not in ("producer", "consumer"):
+            raise ValueError(f"role must be 'producer' or 'consumer', "
+                             f"got {role!r}")
+        self.path = path
+        self.role = role
+        self._mm = mm
+        self._q = memoryview(mm).cast("Q")
+        self._i = memoryview(mm).cast("I")
+        if self._q[_SHM_Q_MAGIC] != SHM_RING_MAGIC:
+            self._release()
+            raise ProtocolError(f"{path}: bad shm ring magic")
+        self.capacity = int(self._q[_SHM_Q_CAPACITY])
+        if self.capacity <= 0 or self.capacity & (self.capacity - 1):
+            self._release()
+            raise ProtocolError(f"{path}: ring capacity {self.capacity} "
+                                f"is not a power of two")
+        self._mask = self.capacity - 1
+        self._data = memoryview(mm)[SHM_RING_HEADER:
+                                    SHM_RING_HEADER + self.capacity]
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, role: str,
+               capacity: int = SHM_RING_DEFAULT_CAPACITY) -> "ShmFrameRing":
+        """Create and map a fresh ring file (the hub side of the attach
+        handshake).  ``capacity`` is rounded up to a power of two."""
+        cap = 1
+        while cap < max(int(capacity), mmap.PAGESIZE):
+            cap <<= 1
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, SHM_RING_HEADER + cap)
+            mm = mmap.mmap(fd, SHM_RING_HEADER + cap)
+        finally:
+            os.close(fd)
+        q = memoryview(mm).cast("Q")
+        q[_SHM_Q_CAPACITY] = cap
+        # magic is stamped LAST: an opener seeing it sees a complete header
+        q[_SHM_Q_MAGIC] = SHM_RING_MAGIC
+        del q
+        return cls(path, mm, role)
+
+    @classmethod
+    def open(cls, path: str, role: str) -> "ShmFrameRing":
+        """Map an existing ring file (the client side of the handshake)."""
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            if size < SHM_RING_HEADER + mmap.PAGESIZE:
+                raise ProtocolError(f"{path}: ring file too small ({size} B)")
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        return cls(path, mm, role)
+
+    # -- the SPSC protocol ----------------------------------------------------
+
+    def _park(self, spins: int, started: float,
+              timeout: Optional[float]) -> int:
+        """One wait step while the ring is full/empty; returns the updated
+        spin count.  Raises ``socket.timeout`` past the deadline."""
+        if timeout is not None and time.monotonic() - started >= timeout:
+            raise socket.timeout("timed out waiting on shm ring")
+        if spins < self._SPIN:
+            return spins + 1
+        time.sleep(min(self._PARK_MIN * (1 << min(spins - self._SPIN, 7)),
+                       self._PARK_MAX))
+        return spins + 1
+
+    def write(self, data, timeout: Optional[float] = None) -> None:
+        """Move ALL of ``data`` into the ring (``sendall`` semantics)."""
+        src = memoryview(data).cast("B") if not isinstance(data, memoryview) \
+            else data.cast("B")
+        off, n = 0, len(src)
+        head = int(self._q[_SHM_Q_HEAD])
+        spins, started, parked = 0, time.monotonic(), False
+        while off < n:
+            if self._i[_SHM_I_CONSUMER_CLOSED]:
+                raise ConnectionError("shm ring consumer closed")
+            free = self.capacity - (head - int(self._q[_SHM_Q_TAIL]))
+            if free == 0:
+                if not parked and obs.enabled():
+                    obs.counter("ps.shm_ring_full_waits").inc()
+                parked = True
+                spins = self._park(spins, started, timeout)
+                continue
+            pos = head & self._mask
+            k = min(n - off, free, self.capacity - pos)
+            self._data[pos:pos + k] = src[off:off + k]
+            off += k
+            head += k
+            # publish AFTER the payload bytes are in place: the consumer
+            # never reads past head, so it can never see torn data
+            self._q[_SHM_Q_HEAD] = head
+            spins, parked = 0, False
+
+    def read_into(self, view, timeout: Optional[float] = None) -> int:
+        """Fill ``view`` with whatever contiguous bytes are available
+        (``recv_into`` semantics: may return fewer than asked; returns 0
+        only when the producer closed and the ring is drained)."""
+        dst = memoryview(view)
+        if dst.nbytes == 0:
+            return 0
+        dst = dst.cast("B")
+        tail = int(self._q[_SHM_Q_TAIL])
+        spins, started = 0, time.monotonic()
+        while True:
+            avail = int(self._q[_SHM_Q_HEAD]) - tail
+            if avail:
+                break
+            if self._i[_SHM_I_PRODUCER_CLOSED]:
+                # re-check head once: close flag may land after final bytes
+                if int(self._q[_SHM_Q_HEAD]) - tail == 0:
+                    return 0
+                continue
+            spins = self._park(spins, started, timeout)
+        pos = tail & self._mask
+        k = min(dst.nbytes, avail, self.capacity - pos)
+        dst[:k] = self._data[pos:pos + k]
+        self._q[_SHM_Q_TAIL] = tail + k
+        return k
+
+    @property
+    def pending(self) -> int:
+        """Bytes written but not yet read (either role may ask)."""
+        return int(self._q[_SHM_Q_HEAD]) - int(self._q[_SHM_Q_TAIL])
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def mark_closed(self) -> None:
+        """Raise BOTH closed flags without unmapping — the shutdown-style
+        wakeup: parked peers (local threads and the process across the
+        ring alike) observe the flag on their next wait iteration and
+        fall out with EOF/``ConnectionError`` instead of sleeping on."""
+        try:
+            self._i[_SHM_I_PRODUCER_CLOSED] = 1
+            self._i[_SHM_I_CONSUMER_CLOSED] = 1
+        except ValueError:
+            pass  # already unmapped
+
+    def _release(self) -> None:
+        self._q = self._i = self._data = None
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # an in-flight view pins the map; the OS reclaims at exit
+
+    def close(self) -> None:
+        """Raise this role's closed flag and unmap.  Idempotent."""
+        try:
+            if self.role == "producer":
+                self._i[_SHM_I_PRODUCER_CLOSED] = 1
+            else:
+                self._i[_SHM_I_CONSUMER_CLOSED] = 1
+        except (TypeError, ValueError):
+            pass  # already closed
+        self._release()
+
+    def unlink(self) -> None:
+        """Remove the ring file (creator-side cleanup); map stays valid."""
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ShmEndpoint:
+    """A socket-shaped duplex endpoint over two :class:`ShmFrameRing`\\ s
+    (one per direction) — the object that replaces ``PSClient.sock`` /
+    the hub's per-connection socket after a successful Z attach.  Every
+    transport helper in this module only touches ``sendall`` /
+    ``recv_into`` / ``settimeout`` / ``shutdown`` / ``close``, so the
+    swap is invisible to the framing layer and the bytes that move are
+    identical to what the socket would have carried.
+
+    The original TCP socket is retained (unread, unwritten) purely as a
+    liveness anchor: closing the endpoint closes it too, so a peer death
+    is observable by the OS even if the dead process never set its ring
+    closed flag."""
+
+    def __init__(self, sock: socket.socket, tx_ring: ShmFrameRing,
+                 rx_ring: ShmFrameRing):
+        self.sock = sock
+        self.tx_ring = tx_ring
+        self.rx_ring = rx_ring
+        self._timeout = sock.gettimeout()
+
+    def sendall(self, data) -> None:
+        self.tx_ring.write(data, timeout=self._timeout)
+        if obs.enabled():
+            obs.counter("ps.shm_frames_total").inc()
+
+    def recv_into(self, view, nbytes: int = 0) -> int:
+        mv = memoryview(view)
+        if nbytes:
+            mv = mv.cast("B")[:nbytes]
+        return self.rx_ring.read_into(mv, timeout=self._timeout)
+
+    def recv(self, n: int) -> bytes:
+        buf = bytearray(n)
+        got = self.recv_into(memoryview(buf), n)
+        return bytes(buf[:got])
+
+    def settimeout(self, timeout: Optional[float]) -> None:
+        self._timeout = timeout
+        try:
+            self.sock.settimeout(timeout)
+        except OSError:
+            pass
+
+    def gettimeout(self) -> Optional[float]:
+        return self._timeout
+
+    def fileno(self) -> int:
+        return self.sock.fileno()
+
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        """Wake every parked reader/writer on both rings (both processes)
+        and sever the anchor socket — the eviction path's guarantee that
+        nothing stays asleep holding a dead connection."""
+        self.tx_ring.mark_closed()
+        self.rx_ring.mark_closed()
+        try:
+            self.sock.shutdown(how)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.tx_ring.close()
+        self.rx_ring.close()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- the Z attach handshake payloads ------------------------------------------
+
+def encode_shm_request(capacity_hint: int = SHM_RING_DEFAULT_CAPACITY) -> bytes:
+    """Step 1, client->hub: one blob = u8 layout version + u64 big-endian
+    ring-capacity hint (the hub may round it; the mapped header is
+    authoritative)."""
+    blob = struct.pack(">BQ", SHM_VERSION, int(capacity_hint))
+    return encode_tensors(ACTION_SHM, [np.frombuffer(blob, np.uint8)])
+
+
+def decode_shm_request(blobs: Sequence) -> Tuple[int, int]:
+    """Inverse of :func:`encode_shm_request` -> (version, capacity_hint)."""
+    if not blobs:
+        raise ProtocolError("Z request carries no header blob")
+    raw = bytes(memoryview(blobs[0]))[:9]
+    if len(raw) != 9:
+        raise ProtocolError(f"Z request blob has {len(raw)} bytes, want 9")
+    version, hint = struct.unpack(">BQ", raw)
+    return int(version), int(hint)
+
+
+def encode_shm_offer(c2h_path: str, h2c_path: str) -> bytes:
+    """Step 2, hub->client (accept): TWO utf-8 path blobs — the
+    client->hub ring file, then the hub->client ring file.  Both already
+    exist and are fully initialized when this frame leaves."""
+    return encode_tensors(ACTION_SHM, [
+        np.frombuffer(c2h_path.encode("utf-8"), np.uint8),
+        np.frombuffer(h2c_path.encode("utf-8"), np.uint8)])
+
+
+def encode_shm_decline() -> bytes:
+    """Step 2, hub->client (decline): zero blobs — the connection simply
+    stays pure TCP, byte-identical to a hub with shm disabled."""
+    return encode_tensors(ACTION_SHM, [])
+
+
+def decode_shm_offer(blobs: Sequence) -> Optional[Tuple[str, str]]:
+    """Inverse of the step-2 reply: ``(c2h_path, h2c_path)`` on an offer,
+    ``None`` on a decline."""
+    if not blobs:
+        return None
+    if len(blobs) != 2:
+        raise ProtocolError(f"Z offer carries {len(blobs)} blobs, want 2")
+    return (bytes(memoryview(blobs[0])).decode("utf-8"),
+            bytes(memoryview(blobs[1])).decode("utf-8"))
+
+
+def encode_shm_confirm(attached: bool) -> bytes:
+    """Step 3, client->hub over TCP: one 1-byte blob — ``b"\\x01"`` the
+    client mapped both rings and its NEXT frame rides them, ``b"\\x00"``
+    mapping failed, stay on TCP.  Because TCP is FIFO, the hub reading
+    this frame knows exactly which transport every subsequent frame uses
+    — the stream can never tear."""
+    return encode_tensors(ACTION_SHM, [
+        np.frombuffer(b"\x01" if attached else b"\x00", np.uint8)])
+
+
+def decode_shm_confirm(blobs: Sequence) -> bool:
+    """Inverse of :func:`encode_shm_confirm`."""
+    if not blobs or len(bytes(memoryview(blobs[0]))) != 1:
+        raise ProtocolError("Z confirm carries no status byte")
+    return bytes(memoryview(blobs[0]))[0] == 1
+
+
+# -- batched socket receive (remote-worker path, ISSUE 18) --------------------
+
+_LIBC = None
+_MMSG_TYPES = None
+
+
+def _libc():
+    global _LIBC
+    if _LIBC is None:
+        import ctypes
+        _LIBC = ctypes.CDLL(None, use_errno=True)
+    return _LIBC
+
+
+def batched_io_available() -> bool:
+    """Runtime guard (the ``require_tool`` idiom, but for a libc symbol):
+    True when ``recvmmsg`` is resolvable, so the batched receive path can
+    drain a commit storm with one syscall per batch.  When False — or on
+    any runtime failure — :class:`BatchedReceiver` silently degrades to
+    plain nonblocking ``recv_into`` drains, which still amortize the
+    parse but not the syscall."""
+    try:
+        return hasattr(_libc(), "recvmmsg")
+    except OSError:
+        return False
+
+
+def _mmsg_types():
+    """The ctypes mirror of ``struct mmsghdr`` (built once)."""
+    global _MMSG_TYPES
+    if _MMSG_TYPES is None:
+        import ctypes
+
+        class IoVec(ctypes.Structure):
+            _fields_ = [("iov_base", ctypes.c_void_p),
+                        ("iov_len", ctypes.c_size_t)]
+
+        class MsgHdr(ctypes.Structure):
+            _fields_ = [("msg_name", ctypes.c_void_p),
+                        ("msg_namelen", ctypes.c_uint),
+                        ("msg_iov", ctypes.POINTER(IoVec)),
+                        ("msg_iovlen", ctypes.c_size_t),
+                        ("msg_control", ctypes.c_void_p),
+                        ("msg_controllen", ctypes.c_size_t),
+                        ("msg_flags", ctypes.c_int)]
+
+        class MMsgHdr(ctypes.Structure):
+            _fields_ = [("msg_hdr", MsgHdr), ("msg_len", ctypes.c_uint)]
+
+        _MMSG_TYPES = (ctypes, IoVec, MMsgHdr)
+    return _MMSG_TYPES
+
+
+class BatchedReceiver:
+    """Frame-granular batched receive for one hub connection: one blocking
+    ``recv_into`` pulls whatever the kernel has (typically MANY pipelined
+    frames from a committing worker), opportunistic nonblocking drains
+    top the buffer up, and subsequent frames are parsed straight out of
+    the buffer with zero syscalls.  The per-batch frame count lands in
+    the ``ps_recv_batch_depth`` histogram — the bench tripwire that the
+    batching actually batches.
+
+    ``recv_frame_into`` mirrors :func:`recv_frame_into`'s contract: the
+    returned memoryview aliases the internal buffer and is valid only
+    until the next call.  Strictly single-reader (the hub's per-
+    connection handler thread)."""
+
+    def __init__(self, sock: socket.socket, frame_hint: int, depth: int = 8):
+        self.sock = sock
+        self.depth = max(1, int(depth))
+        self._buf = bytearray(max(int(frame_hint) + 8, 4096) * self.depth)
+        self._head = 0   # parse offset
+        self._tail = 0   # fill offset
+        self._batch_frames = 0  # frames served since the last blocking fill
+
+    def pending(self) -> int:
+        """Bytes buffered but not yet parsed — must be 0 at any transport
+        handoff (R replication attach, Z shm switch), else frames meant
+        for the next owner were already consumed here."""
+        return self._tail - self._head
+
+    def _compact(self) -> None:
+        if self._head:
+            rem = self._tail - self._head
+            self._buf[:rem] = self._buf[self._head:self._tail]
+            self._head, self._tail = 0, rem
+
+    def _drain_nonblocking(self) -> None:
+        """Top the buffer up without blocking — one ``recvmmsg`` when libc
+        has it, else a ``MSG_DONTWAIT`` recv loop — so a storm of queued
+        frames is consumed in as few syscalls as the kernel allows."""
+        if self.depth > 1 and batched_io_available():
+            try:
+                self._recvmmsg_drain()
+                return
+            except OSError:
+                pass  # fall through to the plain-recv drain
+        while self._tail < len(self._buf):
+            try:
+                n = self.sock.recv_into(
+                    memoryview(self._buf)[self._tail:], 0, socket.MSG_DONTWAIT)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return  # let the next blocking read surface the real error
+            if n == 0:
+                return  # EOF surfaces on the next blocking read
+            self._tail += n
+
+    def _recvmmsg_drain(self) -> None:
+        """One nonblocking ``recvmmsg`` over the free buffer space, carved
+        into ``depth`` iovec segments.  On a stream socket a segment may
+        come back short while a later one still fills, so received runs
+        are compacted back into one contiguous stream before parsing."""
+        ctypes, IoVec, MMsgHdr = _mmsg_types()
+        room = len(self._buf) - self._tail
+        seg = max(room // self.depth, 1)
+        k = min(self.depth, room // seg)
+        if k <= 0 or room <= 0:
+            return
+        base = ctypes.addressof(ctypes.c_char.from_buffer(self._buf,
+                                                          self._tail))
+        iovs = (IoVec * k)()
+        msgs = (MMsgHdr * k)()
+        for i in range(k):
+            iovs[i].iov_base = base + i * seg
+            iovs[i].iov_len = seg if i < k - 1 else room - (k - 1) * seg
+            msgs[i].msg_hdr.msg_iov = ctypes.pointer(iovs[i])
+            msgs[i].msg_hdr.msg_iovlen = 1
+        r = _libc().recvmmsg(self.sock.fileno(), msgs, k,
+                             socket.MSG_DONTWAIT, None)
+        if r <= 0:
+            return  # EAGAIN/EOF/error — the next blocking read decides
+        pos = self._tail
+        for i in range(r):
+            ln = int(msgs[i].msg_len)
+            start = self._tail + i * seg
+            if start != pos and ln:
+                self._buf[pos:pos + ln] = self._buf[start:start + ln]
+            pos += ln
+        self._tail = pos
+
+    def _fill_blocking(self) -> None:
+        """One blocking read (honors the socket timeout), then drain."""
+        self._compact()
+        if obs.enabled() and self._batch_frames:
+            obs.histogram("ps_recv_batch_depth").observe(self._batch_frames)
+        self._batch_frames = 0
+        n = self.sock.recv_into(memoryview(self._buf)[self._tail:])
+        if n == 0:
+            raise ConnectionError("peer closed between frames")
+        self._tail += n
+        self._drain_nonblocking()
+
+    def _ensure(self, need: int) -> None:
+        while self._tail - self._head < need:
+            if self._head + need > len(self._buf):
+                self._compact()
+            if self._head + need > len(self._buf):
+                # one frame larger than the whole batch buffer: grow once
+                self._buf.extend(bytes(self._head + need - len(self._buf)))
+            self._fill_blocking()
+
+    def recv_frame_into(self, limit: int = MAX_FRAME) -> memoryview:
+        """Parse one frame out of the batch buffer (refilling as needed)
+        and return its payload view — drop-in for the hub handler's
+        :func:`recv_frame_into` call, same validation, same counters."""
+        self._ensure(8)
+        (n,) = struct.unpack_from(">Q", self._buf, self._head)
+        if n > limit:
+            raise ProtocolError(f"frame of {n} bytes exceeds limit={limit}")
+        self._ensure(8 + n)
+        start = self._head + 8
+        self._head += 8 + n
+        self._batch_frames += 1
+        if obs.enabled():
+            obs.counter("net_rx_frames_total").inc()
+            obs.counter("net_rx_bytes_total").inc(8 + n)
+        return memoryview(self._buf)[start:start + n]

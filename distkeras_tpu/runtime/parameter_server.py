@@ -45,6 +45,7 @@ import heapq
 import itertools
 import json
 import os
+import queue
 import random
 import socket
 import threading
@@ -864,7 +865,9 @@ class SocketParameterServer:
                  replica_feed_retries: int = 3,
                  replica_feed_backoff: float = 0.2,
                  sparse_leaves: Sequence[int] = (),
-                 adaptive: bool = False):
+                 adaptive: bool = False,
+                 shm_dir: Optional[str] = None,
+                 recv_batch_depth: int = 0):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
@@ -939,6 +942,20 @@ class SocketParameterServer:
         # implementations reject the exact same oversized prefixes
         self._max_payload = net.max_request_payload(self.center,
                                                     self.sparse_leaves)
+        # zero-copy shm transport (ISSUE 18): a directory to create ring
+        # files in arms the action-Z attach handshake — same-host clients
+        # constructed with shm=True move their framed byte stream through
+        # a pair of mmap SPSC rings instead of the kernel socket stack.
+        # None (the default) declines every Z request, byte-identical to
+        # a pre-Z hub from the client's point of view
+        self.shm_dir = None if shm_dir is None else str(shm_dir)
+        self._shm_seq = 0  # ring-file ordinal (under _conn_lock)
+        # batched receive (ISSUE 18): >0 sizes a per-connection
+        # BatchedReceiver to that many frames — a commit storm is drained
+        # with one syscall per batch (recvmmsg when libc has it) instead
+        # of one per frame.  0 (the default) keeps the per-frame
+        # recv_frame_into path untouched
+        self.recv_batch_depth = max(0, int(recv_batch_depth))
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
         # half-open liveness: a peer that dies without FIN used to park its
         # handler in recv() forever.  With idle_timeout set, a connection
@@ -1045,6 +1062,8 @@ class SocketParameterServer:
                 # but say so
                 warnings.warn("restore requested but no snapshot exists "
                               "yet; serving initial weights")
+        if self.shm_dir is not None:
+            os.makedirs(self.shm_dir, exist_ok=True)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -1704,9 +1723,17 @@ class SocketParameterServer:
                     break
                 self._conns.append(conn)
             # Nagle off + kernel buffers sized to one full weights/commit
-            # frame: the pipelined client parks a commit in the send buffer
-            # and returns to compute instead of blocking in sendall
-            net.configure_socket(conn, payload_hint=self._frame_bytes)
+            # frame — times the receive batch depth when batching is on,
+            # so the kernel can actually hold the storm of frames one
+            # recvmmsg batch will drain.  TCP_QUICKACK on the hub side:
+            # the coalesced 13-byte acks are the one latency-critical
+            # tiny send left, and they must not ride the delayed-ack
+            # timer (wire bytes unchanged — pinned by recording-socket)
+            net.configure_socket(
+                conn,
+                payload_hint=self._frame_bytes
+                * max(1, self.recv_batch_depth),
+                quickack=True)
             # ordinal wraps at a fixed slot count so the staleness gauge's
             # label cardinality stays bounded even under elastic-run
             # connection churn (ordinals already restart at 0 per hub,
@@ -1897,6 +1924,15 @@ class SocketParameterServer:
         # codec; None on a dense hub — zero cost when sparse is off
         sp_enc = net.VarFrameEncoder() if self.sparse_leaves else None
         ack = net.empty_tensor_frame(net.ACTION_ACK)
+        # batched receive (ISSUE 18): with a depth configured, frames are
+        # parsed out of one big per-connection buffer that a single
+        # blocking recv (plus nonblocking recvmmsg drains) refills — a
+        # pipelined commit storm costs one syscall per BATCH.  The
+        # receiver wraps the raw TCP socket only; it is retired (asserted
+        # drained) at any transport handoff below
+        receiver = (net.BatchedReceiver(conn, self._frame_bytes,
+                                        self.recv_batch_depth)
+                    if self.recv_batch_depth > 0 else None)
         # set when this connection turns out to be a replica handshake: the
         # socket's ownership moves to the replication feed and this thread
         # must exit WITHOUT closing it
@@ -1913,8 +1949,12 @@ class SocketParameterServer:
                 # a garbage length prefix raises ProtocolError instead of
                 # allocating whatever the 8 bytes happened to say
                 try:
-                    payload = net.recv_frame_into(conn, rx,
-                                                  limit=self._max_payload)
+                    if receiver is not None:
+                        payload = receiver.recv_frame_into(
+                            limit=self._max_payload)
+                    else:
+                        payload = net.recv_frame_into(conn, rx,
+                                                      limit=self._max_payload)
                 except socket.timeout:
                     # silent past the liveness window (no heartbeat, no
                     # traffic): evict — half-open peers must not hold a
@@ -2152,6 +2192,12 @@ class SocketParameterServer:
                         raise net.ProtocolError(
                             f"unexpected replication kind {kind} from a peer "
                             f"(only hello initiates a feed)")
+                    if receiver is not None and receiver.pending():
+                        # bytes batched past the hello belong to the feed's
+                        # stream, which reads the raw socket — handing the
+                        # socket over would silently drop them
+                        raise net.ProtocolError(
+                            "frames batched past a replication hello")
                     with self._feed_lock:
                         if self._feed is None:
                             self._feed = ReplicationFeed(self)
@@ -2187,6 +2233,91 @@ class SocketParameterServer:
                     net.send_frame(conn, net.encode_retry_payload(
                         self._retry_after_ms(
                             net.decode_reconnect_payload(blobs))))
+                elif action == net.ACTION_SHM:
+                    # zero-copy attach handshake (ISSUE 18), entirely
+                    # inside this dispatch arm so the switch point is
+                    # exact: reply with an offer (two freshly created ring
+                    # files) or a decline, then — on an offer — read the
+                    # client's confirm off the SAME TCP stream.  Only an
+                    # attached confirm swaps this connection onto the
+                    # rings; a decline, an abort, or a mapping failure
+                    # leaves it pure TCP, byte-identical to a pre-Z hub
+                    # (analysis/protocol_model.py walks all of this)
+                    version, cap_hint = net.decode_shm_request(blobs)
+                    rings = None
+                    if (self.shm_dir is not None
+                            and version == net.SHM_VERSION
+                            and not isinstance(conn, net.ShmEndpoint)):
+                        with self._conn_lock:
+                            self._shm_seq += 1
+                            tag = self._shm_seq
+                        base = os.path.join(
+                            self.shm_dir, f"ring-{self.port}-{tag}")
+                        # each ring must hold at least a couple of this
+                        # connection's largest frames or the transport
+                        # would deadlock pipelined exchanges on capacity
+                        cap = max(int(cap_hint), 2 * self._frame_bytes,
+                                  net.SHM_RING_DEFAULT_CAPACITY)
+                        try:
+                            rings = (net.ShmFrameRing.create(
+                                         base + ".c2h", "consumer", cap),
+                                     net.ShmFrameRing.create(
+                                         base + ".h2c", "producer", cap))
+                        except OSError:
+                            rings = None  # can't create -> decline
+                    if rings is None:
+                        net.send_frame(conn, net.encode_shm_decline())
+                    else:
+                        rx_ring, tx_ring = rings
+                        try:
+                            net.send_frame(conn, net.encode_shm_offer(
+                                rx_ring.path, tx_ring.path))
+                            # the confirm is the very next frame on the
+                            # TCP FIFO — read it where the batched
+                            # receiver (if any) already is
+                            if receiver is not None:
+                                c_payload = receiver.recv_frame_into(
+                                    limit=self._max_payload)
+                            else:
+                                c_payload = net.recv_frame_into(
+                                    conn, rx, limit=self._max_payload)
+                            c_action, c_blobs = net.decode_tensor_views(
+                                c_payload)
+                            if c_action != net.ACTION_SHM:
+                                raise net.ProtocolError(
+                                    f"expected Z confirm after shm offer, "
+                                    f"got {c_action!r}")
+                            attached = net.decode_shm_confirm(c_blobs)
+                        except BaseException:
+                            rx_ring.close()
+                            tx_ring.close()
+                            rx_ring.unlink()
+                            tx_ring.unlink()
+                            raise
+                        # the client has mapped (or abandoned) the files;
+                        # either way the names can leave the filesystem —
+                        # the mappings keep the memory alive
+                        rx_ring.unlink()
+                        tx_ring.unlink()
+                        if attached:
+                            if receiver is not None and receiver.pending():
+                                raise net.ProtocolError(
+                                    "frames batched past an shm attach")
+                            receiver = None  # rings need no syscall batching
+                            endpoint = net.ShmEndpoint(conn, tx_ring,
+                                                       rx_ring)
+                            # stop()'s sever loop must wake the ring, not
+                            # just the now-idle anchor socket
+                            with self._conn_lock:
+                                if conn in self._conns:
+                                    self._conns[self._conns.index(conn)] = \
+                                        endpoint
+                            conn = endpoint
+                            if self.idle_timeout is not None:
+                                conn.settimeout(self.idle_timeout)
+                        else:
+                            rx_ring.close()
+                            tx_ring.close()
                 elif action == net.ACTION_PING:
                     # heartbeat-on-idle: proves liveness (resetting the
                     # idle clock above) and keeps a slow-but-alive worker's
@@ -3011,7 +3142,8 @@ class PSClient(_HotTierCacheSurface):
                  failover: Sequence[Tuple[str, int]] = (),
                  sparse_leaves: Sequence[int] = (),
                  adaptive: bool = False,
-                 sparse_cache_rows: Optional[int] = None):
+                 sparse_cache_rows: Optional[int] = None,
+                 shm: bool = False):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -3127,6 +3259,15 @@ class PSClient(_HotTierCacheSurface):
         # moves, the byte stream is exactly the pre-adaptive one
         self.adaptive = bool(adaptive)
         self.backpressure_waits = 0
+        # zero-copy shm transport (ISSUE 18): shm=True asks every fresh
+        # connection for an shm attach (action Z).  The hub offers a ring
+        # pair (same host, shm armed) or declines; a LEGACY hub closing
+        # on the unknown action reads as a decline too — the client
+        # redials plain TCP once, so the stream is never torn.  transport
+        # reports what this connection actually rides ("tcp"/"shm") —
+        # health reports carry it, distkeras-top displays it
+        self.shm = bool(shm)
+        self.transport = "tcp"
         # entropy-seeded ON PURPOSE: the jitter exists so a fleet of
         # workers severed by one hub restart does NOT retry in lockstep —
         # a shared deterministic seed would reproduce exactly that herd
@@ -3144,6 +3285,7 @@ class PSClient(_HotTierCacheSurface):
                          else contextlib.nullcontext())
         self._last_io = time.monotonic()
         self.sock = self._connect_any()
+        self._maybe_attach_shm()
         # distributed tracing (ISSUE #5): this worker's trace context,
         # announced over the wire (action T) so the hub's spans are
         # attributable, with the local->hub clock offset estimated from
@@ -3250,6 +3392,59 @@ class PSClient(_HotTierCacheSurface):
             self.host, self.port = host, port
             return sock
         raise first_err  # at least one address exists, so this is set
+
+    def _maybe_attach_shm(self) -> None:
+        """The action-Z attach on a freshly dialed connection (shm clients
+        only): request, map the offered ring pair, confirm over TCP, then
+        swap :attr:`sock` for a :class:`~.networking.ShmEndpoint` — every
+        subsequent frame rides shared memory, byte-identical to what the
+        socket would have carried.  A decline (or a mapping failure,
+        aborted over TCP) leaves the connection pure TCP; a legacy hub
+        CLOSING on the unknown action is treated as a decline and the
+        client redials plain TCP once — the connection fault never
+        escapes, so the protocol model's never-torn walk holds here."""
+        self.transport = "tcp"
+        if not self.shm:
+            return
+        try:
+            net.send_frame(self.sock, net.encode_shm_request(
+                max(net.SHM_RING_DEFAULT_CAPACITY,
+                    2 * self._codec.frame_len)))
+            action, blobs = net.recv_tensors(self.sock)
+            if action != net.ACTION_SHM:
+                raise net.ProtocolError(
+                    f"expected Z reply to shm request, got {action!r}")
+            offer = net.decode_shm_offer(blobs)
+        except (ConnectionError, OSError, net.ProtocolError):
+            # legacy hub: it dropped the connection on the unknown
+            # action.  No frame beyond the Z request ever moved, so a
+            # single plain-TCP redial resumes cleanly
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = self._connect_any()
+            return
+        if offer is None:
+            return  # hub declined; stay on TCP
+        c2h_path, h2c_path = offer
+        try:
+            tx_ring = net.ShmFrameRing.open(c2h_path, "producer")
+        except (OSError, net.ProtocolError):
+            net.send_frame(self.sock, net.encode_shm_confirm(False))
+            return
+        try:
+            rx_ring = net.ShmFrameRing.open(h2c_path, "consumer")
+        except (OSError, net.ProtocolError):
+            tx_ring.close()
+            net.send_frame(self.sock, net.encode_shm_confirm(False))
+            return
+        # confirm rides TCP: the hub reads it off the FIFO, so both ends
+        # agree the very NEXT frame is on the rings — never a torn stream
+        net.send_frame(self.sock, net.encode_shm_confirm(True))
+        self.sock = net.ShmEndpoint(self.sock, tx_ring, rx_ring)
+        self.sock.settimeout(self.timeout)
+        self.transport = "shm"
 
     def _heartbeat_loop(self) -> None:
         interval = self.heartbeat_interval
@@ -3410,6 +3605,12 @@ class PSClient(_HotTierCacheSurface):
                             time.sleep(hint_ms / 1000.0)
                             skip_backoff = True
                             continue
+                    # re-negotiate the shm attach on the fresh connection
+                    # (ring files are per-connection; the old pair died
+                    # with the old socket).  Landing on TCP — a standby
+                    # with shm off, a remote failover target — is a
+                    # degrade, not a fault
+                    self._maybe_attach_shm()
                     # re-announce the trace context on the fresh
                     # connection (a restarted hub has no memory of the
                     # old one) and refresh the clock-offset estimate
@@ -3973,6 +4174,9 @@ class InprocPSClient(_HotTierCacheSurface):
                           if compress else None)
         self._last_pull_clock = 0
         self._pulled: Optional[List[np.ndarray]] = None
+        # what the health plane's TRANS column reports for this worker
+        # (PSClient: "tcp"/"shm" depending on the attach negotiation)
+        self.transport = "inproc"
         # inproc shares the hub's process AND clock: the context needs no
         # wire announce (the hub reads the worker thread's context via
         # dtrace.current()), and the clock offset is exactly zero — which
@@ -4529,6 +4733,86 @@ class SnapshotSetCoordinator:
                               f"{type(e).__name__}: {e}")
 
 
+class _ShardWorkerPool:
+    """One long-lived handler thread per shard hub (ISSUE 18): a striped
+    direct-transport request dispatches one closure per shard and joins —
+    so a 4-shard in-process hub applies the 4 stripes on 4 cores instead
+    of walking them sequentially on the caller's thread.  Safe because
+    the shards are DISJOINT state (each hub has its own center, lock and
+    clock — the same isolation the per-connection socket handlers rely
+    on), and numpy's apply kernels release the GIL.  Results are
+    bit-identical to the sequential walk: each stripe runs the exact same
+    per-hub call, just concurrently with its siblings.
+
+    Each shard's queue is strictly FIFO and single-consumer, so two
+    overlapped striped commits keep their per-shard apply order.  No new
+    lock is introduced (the queues synchronize internally); the pool
+    holds none while running a closure, so it cannot participate in any
+    lock-order cycle."""
+
+    def __init__(self, num_shards: int):
+        self._queues = [queue.SimpleQueue() for _ in range(num_shards)]
+        self._threads: List[threading.Thread] = []
+        self.running = False
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self.running = True
+        for i, q in enumerate(self._queues):
+            t = threading.Thread(target=self._loop, args=(q,),
+                                 name=f"dk-shard-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _loop(q: "queue.SimpleQueue") -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                fn()
+            except BaseException as e:
+                box[0] = e
+            done.set()
+
+    def run(self, thunks: Sequence[Any]) -> None:
+        """Run one thunk per shard, in parallel, and join.  The FIRST
+        shard's error (in shard order) is re-raised after every shard
+        finished — a failed stripe must not leave siblings mid-apply.
+        Before start()/after stop() the thunks run sequentially inline,
+        so lifecycle edges never drop work."""
+        if not self.running:
+            for fn in thunks:
+                fn()
+            return
+        boxes = []
+        events = []
+        for q, fn in zip(self._queues, thunks):
+            box: List[Optional[BaseException]] = [None]
+            done = threading.Event()
+            q.put((fn, box, done))
+            boxes.append(box)
+            events.append(done)
+        for done in events:
+            done.wait()
+        for box in boxes:
+            if box[0] is not None:
+                raise box[0]
+
+    def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+
 class ShardedParameterServer:
     """Facade over N per-shard hubs: one :class:`SocketParameterServer`
     subclass (or :class:`~distkeras_tpu.runtime.native.
@@ -4561,7 +4845,8 @@ class ShardedParameterServer:
                  snapshot_dir: Optional[str] = None,
                  snapshot_interval: float = 30.0,
                  snapshot_keep: int = 3,
-                 restore: bool = False):
+                 restore: bool = False,
+                 parallel_direct: bool = True):
         if plan.num_leaves != len(weights):
             raise ValueError(f"plan covers {plan.num_leaves} leaves, model "
                              f"has {len(weights)}")
@@ -4569,6 +4854,14 @@ class ShardedParameterServer:
         self.shards: List[Any] = []
         for sid, shard_weights in enumerate(plan.split(list(weights))):
             self.shards.append(hub_factory(shard_weights, sid))
+        # per-shard handler pool (ISSUE 18): striped direct pulls/commits
+        # fan out to one long-lived thread per shard, so an in-process
+        # multi-shard hub uses one core PER SHARD instead of serializing
+        # the stripes on the caller.  parallel_direct=False keeps the
+        # sequential walk (bit-identical results either way — the shards
+        # are disjoint)
+        self._pool = (_ShardWorkerPool(plan.num_shards)
+                      if parallel_direct and plan.num_shards > 1 else None)
         # coordinated snapshot sets (ISSUE 7): when the facade owns the
         # durability story, the N per-shard snapshots are taken inside one
         # commit barrier and restored only as a complete, clock-consistent
@@ -4606,8 +4899,12 @@ class ShardedParameterServer:
             raise
         if self.coordinator is not None:
             self.coordinator.start()
+        if self._pool is not None:
+            self._pool.start()
 
     def stop(self) -> None:
+        if self._pool is not None:
+            self._pool.stop()
         if self.coordinator is not None:
             self.coordinator.stop(final_snapshot=True)
         for hub in self.shards:
@@ -4617,6 +4914,8 @@ class ShardedParameterServer:
         """Crash-like teardown of every shard (see
         ``SocketParameterServer.kill``): no final snapshot set — recovery
         must come from the last periodic one."""
+        if self._pool is not None:
+            self._pool.stop()
         if self.coordinator is not None:
             self.coordinator.stop(final_snapshot=False)
         for hub in self.shards:
@@ -4654,12 +4953,21 @@ class ShardedParameterServer:
         tuple rides back through the matching :meth:`commit_direct` —
         opaque to :class:`InprocPSClient`, exactly like the int clock of
         an unsharded hub."""
-        shard_weights: List[List[np.ndarray]] = []
-        clocks: List[int] = []
-        for hub in self.shards:
-            w, c = hub.pull_direct()
-            shard_weights.append(w)
-            clocks.append(c)
+        n = self.plan.num_shards
+        shard_weights: List[Any] = [None] * n
+        clocks: List[Any] = [None] * n
+
+        def make(i: int, hub: Any):
+            def fn() -> None:
+                shard_weights[i], clocks[i] = hub.pull_direct()
+            return fn
+
+        thunks = [make(i, hub) for i, hub in enumerate(self.shards)]
+        if self._pool is not None:
+            self._pool.run(thunks)
+        else:
+            for fn in thunks:
+                fn()
         return self.plan.assemble(shard_weights), tuple(clocks)
 
     def commit_direct(self, delta: Sequence[np.ndarray],
@@ -4674,8 +4982,19 @@ class ShardedParameterServer:
             # a plain int (the inproc client's commit-before-first-pull
             # default of 0): broadcast to every shard's clock domain
             clocks = [int(last_pull_clock)] * self.plan.num_shards
-        for hub, part, clock in zip(self.shards, parts, clocks):
-            hub.commit_direct(part, clock)
+
+        def make(hub: Any, part: Any, clock: Any):
+            def fn() -> None:
+                hub.commit_direct(part, clock)
+            return fn
+
+        thunks = [make(hub, part, clock)
+                  for hub, part, clock in zip(self.shards, parts, clocks)]
+        if self._pool is not None:
+            self._pool.run(thunks)
+        else:
+            for fn in thunks:
+                fn()
 
     # -- live health plane (ISSUE 8) -------------------------------------------
     def _ingest_health(self, report: Dict[str, Any]) -> None:
@@ -4732,7 +5051,8 @@ class ShardedPSClient:
                  failover: Optional[Sequence[Any]] = None,
                  sparse_leaves: Sequence[int] = (),
                  adaptive: bool = False,
-                 sparse_cache_rows: Optional[int] = None):
+                 sparse_cache_rows: Optional[int] = None,
+                 shm: bool = False):
         if sparse_cache_rows is not None:
             # the striped client's whole sparse design is row-range VIEWS
             # of one full-size cache; a bounded hot tier would need
@@ -4787,7 +5107,7 @@ class ShardedPSClient:
                     if self._sparse else (),
                     failover=_normalize_failover(
                         failover[sid] if failover is not None else None),
-                    adaptive=adaptive)
+                    adaptive=adaptive, shm=shm)
                 # rebind the shard client's caches to row-range views of
                 # the full tables (contiguous slices, so fancy-indexed
                 # merges land in the full cache directly)
@@ -4801,6 +5121,19 @@ class ShardedPSClient:
         except BaseException:
             self.close()
             raise
+
+    @property
+    def transport(self) -> str:
+        """Aggregate of the stripes' negotiated transports: ``"shm"``
+        when every shard connection attached a ring pair, ``"tcp"`` when
+        none did, ``"mixed"`` otherwise (e.g. one shard's hub declined —
+        legal, each stripe negotiates independently)."""
+        kinds = {getattr(c, "transport", "tcp") for c in self.shards}
+        if kinds == {"shm"}:
+            return "shm"
+        if kinds <= {"tcp"}:
+            return "tcp"
+        return "mixed"
 
     def _stripe(self, sid: int, op):
         """Run one shard client's op, converting an unrecovered connection

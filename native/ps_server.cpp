@@ -39,6 +39,14 @@
 //                center) and thereafter receives one R delta frame per
 //                applied commit, written BEFORE the committing worker's
 //                ack (the zero-acked-commit-loss contract of ISSUE 7)
+//            'Z' shm attach request (u8 version + u64 capacity hint) ->
+//                'Z' offer (two ring-file path blobs) or decline (zero
+//                blobs); on offer the client confirms with one more 'Z'
+//                (one 1-byte blob) and both sides switch the SAME byte
+//                stream onto a pair of mmap rings — frames after the
+//                confirm move over shared memory, byte-identical to the
+//                socket encoding (ISSUE 18; opt-in, legacy peers never
+//                see the action)
 //            'B' bye -> connection closes
 //
 // Locking (the ISSUE-11 hot-path redesign):
@@ -77,9 +85,13 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
+
+#include <cstdio>
 
 #include <cctype>
 #include <cerrno>
@@ -241,6 +253,234 @@ int connect_to(const char* host, int port, int timeout_ms) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
+}
+
+// -- shared-memory frame ring (ISSUE 18) --------------------------------------
+// mmap-backed SPSC byte ring, layout-identical to networking.ShmFrameRing
+// (the Python side maps the same file).  Native-endian header:
+//   u64 magic @0, u64 capacity @8, u64 head @64, u64 tail @128,
+//   u32 producer_closed @192, u32 consumer_closed @196, data @4096.
+// head/tail are free-running TOTAL byte counters (capacity is a power of
+// two; position = counter & (capacity-1)).  The producer stores only head,
+// the consumer only tail — the SPSC contract that needs no lock, just
+// release on the writer's own counter and acquire on the peer's.  The ring
+// carries the exact framed byte stream the socket would, so wire parity
+// holds by construction.
+struct ShmRing {
+  static constexpr uint64_t kMagic = 0x646b2d72696e6731ULL;  // "dk-ring1"
+  static constexpr size_t kHeaderBytes = 4096;
+  static constexpr int kSpin = 200;  // busy iterations before parking
+
+  unsigned char* base_ = nullptr;
+  size_t map_len_ = 0;
+  uint64_t capacity_ = 0;
+  bool producer_ = false;
+  std::atomic<uint64_t>* head_ = nullptr;
+  std::atomic<uint64_t>* tail_ = nullptr;
+  std::atomic<uint32_t>* producer_closed_ = nullptr;
+  std::atomic<uint32_t>* consumer_closed_ = nullptr;
+  unsigned char* data_ = nullptr;
+
+  ~ShmRing() {
+    if (base_) {
+      // severing a live connection must WAKE a parked peer (the protocol
+      // model's sever_wakes_ring_peer rule): raise BOTH flags, then unmap
+      producer_closed_->store(1, std::memory_order_release);
+      consumer_closed_->store(1, std::memory_order_release);
+      ::munmap(base_, map_len_);
+    }
+  }
+
+  void bind_header() {
+    capacity_ = *reinterpret_cast<uint64_t*>(base_ + 8);
+    head_ = reinterpret_cast<std::atomic<uint64_t>*>(base_ + 64);
+    tail_ = reinterpret_cast<std::atomic<uint64_t>*>(base_ + 128);
+    producer_closed_ = reinterpret_cast<std::atomic<uint32_t>*>(base_ + 192);
+    consumer_closed_ = reinterpret_cast<std::atomic<uint32_t>*>(base_ + 196);
+    data_ = base_ + kHeaderBytes;
+  }
+
+  static ShmRing* create(const char* path, bool producer, uint64_t capacity) {
+    // round up to a power of two >= one page (the Python opener validates)
+    uint64_t cap = 4096;
+    while (cap < capacity) cap <<= 1;
+    int fd = ::open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0) return nullptr;
+    if (::ftruncate(fd, off_t(kHeaderBytes + cap)) != 0) {
+      ::close(fd);
+      ::unlink(path);
+      return nullptr;
+    }
+    void* m = ::mmap(nullptr, size_t(kHeaderBytes + cap),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);  // the mapping keeps the pages alive
+    if (m == MAP_FAILED) {
+      ::unlink(path);
+      return nullptr;
+    }
+    auto* r = new ShmRing();
+    r->base_ = static_cast<unsigned char*>(m);
+    r->map_len_ = size_t(kHeaderBytes + cap);
+    r->producer_ = producer;
+    *reinterpret_cast<uint64_t*>(r->base_ + 8) = cap;
+    r->bind_header();
+    // magic stamped LAST (release): a racing opener either sees no magic
+    // (not a ring yet) or a fully-initialized header
+    reinterpret_cast<std::atomic<uint64_t>*>(r->base_)
+        ->store(kMagic, std::memory_order_release);
+    return r;
+  }
+
+  static ShmRing* open_existing(const char* path, bool producer) {
+    int fd = ::open(path, O_RDWR);
+    if (fd < 0) return nullptr;
+    struct stat st {};
+    if (::fstat(fd, &st) != 0 || size_t(st.st_size) <= kHeaderBytes) {
+      ::close(fd);
+      return nullptr;
+    }
+    void* m = ::mmap(nullptr, size_t(st.st_size), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (m == MAP_FAILED) return nullptr;
+    auto* base = static_cast<unsigned char*>(m);
+    uint64_t magic = reinterpret_cast<std::atomic<uint64_t>*>(base)->load(
+        std::memory_order_acquire);
+    uint64_t cap = *reinterpret_cast<uint64_t*>(base + 8);
+    if (magic != kMagic || cap == 0 || (cap & (cap - 1)) != 0 ||
+        kHeaderBytes + cap != size_t(st.st_size)) {
+      // not (yet) a ring: unmap WITHOUT touching the closed flags — this
+      // mapping may be some other file entirely
+      ::munmap(m, size_t(st.st_size));
+      return nullptr;
+    }
+    auto* r = new ShmRing();
+    r->base_ = base;
+    r->map_len_ = size_t(st.st_size);
+    r->producer_ = producer;
+    r->bind_header();
+    return r;
+  }
+
+  // busy-then-park: stay hot for kSpin iterations, then sleep with
+  // exponential backoff 10us..1ms (the Python ring's exact policy).
+  // false = deadline passed or the hub's stop flag cleared.
+  static bool park(int* spins, int64_t started_ns, int timeout_ms,
+                   const std::atomic<bool>* stop) {
+    if (stop && !stop->load(std::memory_order_relaxed)) return false;
+    if (timeout_ms > 0 &&
+        mono_ns() - started_ns > int64_t(timeout_ms) * 1000000)
+      return false;
+    ++*spins;
+    if (*spins <= kSpin) return true;
+    int shift = *spins - kSpin;
+    if (shift > 7) shift = 7;
+    long ns = 10000L << shift;
+    if (ns > 1000000L) ns = 1000000L;
+    timespec ts{0, ns};
+    ::nanosleep(&ts, nullptr);
+    return true;
+  }
+
+  // sendall semantics; false = consumer gone / stop / deadline
+  bool write(const unsigned char* p, size_t n, int timeout_ms,
+             const std::atomic<bool>* stop) {
+    uint64_t head = head_->load(std::memory_order_relaxed);
+    size_t done = 0;
+    int spins = 0;
+    int64_t started = mono_ns();
+    while (done < n) {
+      if (consumer_closed_->load(std::memory_order_acquire)) return false;
+      uint64_t tail = tail_->load(std::memory_order_acquire);
+      uint64_t free_b = capacity_ - (head - tail);
+      if (free_b == 0) {
+        if (!park(&spins, started, timeout_ms, stop)) return false;
+        continue;
+      }
+      spins = 0;
+      uint64_t at = head & (capacity_ - 1);
+      uint64_t chunk = uint64_t(n - done);
+      if (chunk > free_b) chunk = free_b;
+      if (chunk > capacity_ - at) chunk = capacity_ - at;
+      std::memcpy(data_ + at, p + done, size_t(chunk));
+      head += chunk;
+      // payload first, head AFTER (release): the consumer's acquire load
+      // of head can only ever observe fully-copied bytes
+      head_->store(head, std::memory_order_release);
+      done += size_t(chunk);
+    }
+    return true;
+  }
+
+  // one recv()-shaped read: >0 bytes out, 0 = clean EOF (producer closed
+  // and drained), -1 = deadline / stop (deadline sets *timed_out)
+  ssize_t read_some(unsigned char* p, size_t n, int timeout_ms,
+                    const std::atomic<bool>* stop, bool* timed_out) {
+    uint64_t tail = tail_->load(std::memory_order_relaxed);
+    int spins = 0;
+    int64_t started = mono_ns();
+    for (;;) {
+      uint64_t head = head_->load(std::memory_order_acquire);
+      if (head != tail) {
+        uint64_t at = tail & (capacity_ - 1);
+        uint64_t chunk = head - tail;
+        if (chunk > uint64_t(n)) chunk = uint64_t(n);
+        if (chunk > capacity_ - at) chunk = capacity_ - at;
+        std::memcpy(p, data_ + at, size_t(chunk));
+        tail_->store(tail + chunk, std::memory_order_release);
+        return ssize_t(chunk);
+      }
+      if (producer_closed_->load(std::memory_order_acquire)) {
+        // one re-check: bytes published before the flag must drain first
+        if (head_->load(std::memory_order_acquire) != tail) continue;
+        return 0;
+      }
+      if (!park(&spins, started, timeout_ms, stop)) {
+        if (timed_out && !(stop && !stop->load(std::memory_order_relaxed)))
+          *timed_out = true;  // genuine deadline, not a hub shutdown
+        return -1;
+      }
+    }
+  }
+};
+
+// per-connection I/O endpoint: a TCP fd, optionally switched onto a ring
+// pair mid-life by the 'Z' attach handshake.  The byte stream is identical
+// either way — the rings carry the exact frames the socket would.
+struct ConnIo {
+  int fd = -1;
+  ShmRing* rx = nullptr;  // client->hub ring (this side consumes)
+  ShmRing* tx = nullptr;  // hub->client ring (this side produces)
+  int timeout_ms = 0;     // ring deadline, mirroring SO_RCVTIMEO/SO_SNDTIMEO
+  const std::atomic<bool>* stop = nullptr;  // hub running_ flag (wakes parks)
+};
+
+ssize_t io_recv_some(ConnIo& io, unsigned char* buf, size_t n,
+                     bool* timed_out) {
+  if (io.rx)
+    return io.rx->read_some(buf, n, io.timeout_ms, io.stop, timed_out);
+  ssize_t r = ::recv(io.fd, buf, n, 0);
+  if (r < 0 && timed_out && (errno == EAGAIN || errno == EWOULDBLOCK))
+    *timed_out = true;
+  return r;
+}
+
+bool io_write_all(ConnIo& io, const void* buf, size_t n) {
+  if (io.tx)
+    return io.tx->write(static_cast<const unsigned char*>(buf), n,
+                        io.timeout_ms, io.stop);
+  return write_all(io.fd, buf, n);
+}
+
+bool io_writev_all(ConnIo& io, struct iovec* iov, int iovcnt) {
+  if (!io.tx) return writev_all(io.fd, iov, iovcnt);
+  // a ring write is a memcpy, not a syscall: segment-at-a-time keeps the
+  // byte stream identical with zero gather cost
+  for (int i = 0; i < iovcnt; ++i)
+    if (!io.tx->write(static_cast<const unsigned char*>(iov[i].iov_base),
+                      iov[i].iov_len, io.timeout_ms, io.stop))
+      return false;
+  return true;
 }
 
 // R-frame header kinds (first blob, 9 bytes big-endian: u64 clock, u8 kind)
@@ -455,6 +695,10 @@ class ParameterServer {
   }
 
   ~ParameterServer() { stop(); }
+
+  // enable the shm attach path: rings for 'Z'-capable clients are created
+  // under this directory.  Call before start() (the Python wrapper does)
+  void set_shm_dir(const char* dir) { shm_dir_ = dir ? dir : ""; }
 
   void set_replica_of(const char* host, int port, int retries,
                       int backoff_ms) {
@@ -1906,7 +2150,7 @@ class ParameterServer {
   }
 
   // -- replies ----------------------------------------------------------------
-  bool send_weights(int fd, const float* snap) {
+  bool send_weights(ConnIo& io, const float* snap) {
     std::vector<struct iovec> iov(1 + 2 * sizes_.size());
     iov[0].iov_base = w_hdr_.data();
     iov[0].iov_len = 13;
@@ -1917,17 +2161,17 @@ class ParameterServer {
           const_cast<float*>(snap + offsets_[i]);
       iov[2 + 2 * i].iov_len = size_t(sizes_[i]) * 4;
     }
-    return writev_all(fd, iov.data(), int(iov.size()));
+    return io_writev_all(io, iov.data(), int(iov.size()));
   }
 
-  bool send_u64_reply(int fd, char action, uint64_t value) {
+  bool send_u64_reply(ConnIo& io, char action, uint64_t value) {
     unsigned char buf[8 + 5 + 8 + 8];
     be64_encode(5 + 8 + 8, buf);
     buf[8] = (unsigned char)action;
     be32_encode(1, buf + 9);
     be64_encode(8, buf + 13);
     be64_encode(value, buf + 21);
-    return write_all(fd, buf, sizeof(buf));
+    return io_write_all(io, buf, sizeof(buf));
   }
 
   void handle_connection(int fd) {
@@ -1951,6 +2195,11 @@ class ParameterServer {
     // pipelined client's parked commit + pull request arrive together
     std::vector<unsigned char> rx(4096);
     size_t rx_begin = 0, rx_end = 0;
+    // shm transport (ISSUE 18): after a completed 'Z' handshake the SAME
+    // byte stream continues over this ring pair; every read/write below
+    // routes through io so the switch is invisible to the protocol code
+    ConnIo io{fd, nullptr, nullptr, idle_timeout_ms_, &running_};
+    std::unique_ptr<ShmRing> shm_rx, shm_tx;
 
     auto flush_acks = [&]() -> bool {
       if (pending_acks == 0) return true;
@@ -1962,7 +2211,7 @@ class ParameterServer {
         be32_encode(0, p + 9);
       }
       pending_acks = 0;
-      return write_all(fd, acks.data(), acks.size());
+      return io_write_all(io, acks.data(), acks.size());
     };
     auto ensure = [&](size_t need) -> bool {
       while (rx_end - rx_begin < need) {
@@ -1975,12 +2224,9 @@ class ParameterServer {
           rx_begin = 0;
           if (need > rx.size()) rx.resize(need);
         }
-        ssize_t r = ::recv(fd, rx.data() + rx_end, rx.size() - rx_end, 0);
-        if (r <= 0) {
-          if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
-            timed_out = true;
-          return false;
-        }
+        ssize_t r = io_recv_some(io, rx.data() + rx_end, rx.size() - rx_end,
+                                 &timed_out);
+        if (r <= 0) return false;
         rx_end += size_t(r);
       }
       return true;
@@ -2011,7 +2257,7 @@ class ParameterServer {
           std::memcpy(snapf.data(), center_.data(),
                       center_.size() * sizeof(float));
         }
-        if (!send_weights(fd, snapf.data())) break;
+        if (!send_weights(io, snapf.data())) break;
 
       } else if (action == 'C' || action == 'Q') {
         if (!parse_dense_commit(payload, n, action == 'Q', qbuf, blobs, parts))
@@ -2137,7 +2383,7 @@ class ParameterServer {
             fold_touch_locked();
           }
         }
-        if (!write_all(fd, sp_tx.data(), sp_tx.size())) break;
+        if (!io_write_all(io, sp_tx.data(), sp_tx.size())) break;
 
       } else if (action == 'H') {  // heartbeat: liveness proof, acked
         ++pending_acks;
@@ -2166,7 +2412,7 @@ class ParameterServer {
           ctx_worker = json_int_field(blobs[0].first, size_t(blobs[0].second),
                                       "worker_id", -1);
         if (!flush_acks()) break;
-        if (!send_u64_reply(fd, 'T', uint64_t(mono_ns()))) break;
+        if (!send_u64_reply(io, 'T', uint64_t(mono_ns()))) break;
 
       } else if (action == 'G') {
         // reconnect announce: answer with a retry-after hint (0 =
@@ -2176,13 +2422,14 @@ class ParameterServer {
             blobs[0].second >= 8)
           waits = int64_t(be64_decode(blobs[0].first));
         if (!flush_acks()) break;
-        if (!send_u64_reply(fd, 'Y', uint64_t(retry_after_ms(waits)))) break;
+        if (!send_u64_reply(io, 'Y', uint64_t(retry_after_ms(waits)))) break;
 
       } else if (action == 'R') {
         // replica handshake: this peer is a hot standby, not a worker —
         // attach it to the replication feed and hand the socket over.
         // A 10th header byte (optional — legacy hellos are 9 bytes)
         // carries the standby's frame-kind capabilities
+        if (io.rx) break;  // the feed owns a raw fd; no hello after attach
         if (!parse_blob_table(payload, n, blobs) || blobs.size() != 1 ||
             (blobs[0].second != 9 && blobs[0].second != 10))
           break;
@@ -2201,6 +2448,97 @@ class ParameterServer {
         handoff = true;
         feed_->attach(fd, repl_caps);  // on failure attach closes the fd
         return;
+
+      } else if (action == 'Z') {
+        // shm attach handshake (ISSUE 18), resolved ENTIRELY inside this
+        // dispatch arm: request (this frame) -> offer/decline + confirm
+        // (both still over TCP) -> switch.  TCP FIFO makes the switch
+        // point exact — the first post-confirm frame is already on the
+        // ring — so the stream is never torn (the protocol model's
+        // SHM_RULES walk every interleaving of this exchange)
+        if (!parse_blob_table(payload, n, blobs) || blobs.size() != 1 ||
+            blobs[0].second != 9)
+          break;
+        unsigned version = blobs[0].first[0];
+        uint64_t cap_hint = be64_decode(blobs[0].first + 1);
+        if (!flush_acks()) break;
+        std::unique_ptr<ShmRing> cand_rx, cand_tx;
+        std::string c2h_path, h2c_path;
+        if (!shm_dir_.empty() && version == 1 && io.rx == nullptr) {
+          char name[96];
+          std::snprintf(name, sizeof(name), "/ring-%d-%llu", bound_port_,
+                        (unsigned long long)shm_seq_.fetch_add(1));
+          c2h_path = shm_dir_ + name + ".c2h";
+          h2c_path = shm_dir_ + name + ".h2c";
+          uint64_t cap = cap_hint;
+          uint64_t floor_b = uint64_t(2) * uint64_t(8 + dense_payload_f32_);
+          if (cap < floor_b) cap = floor_b;
+          if (cap < (uint64_t(1) << 20)) cap = uint64_t(1) << 20;
+          cand_rx.reset(ShmRing::create(c2h_path.c_str(), false, cap));
+          if (cand_rx) cand_tx.reset(ShmRing::create(h2c_path.c_str(), true, cap));
+          if (!cand_rx || !cand_tx) {  // dir vanished / ENOSPC -> decline
+            cand_rx.reset();
+            cand_tx.reset();
+            ::unlink(c2h_path.c_str());
+            ::unlink(h2c_path.c_str());
+          }
+        }
+        bool offered = bool(cand_rx) && bool(cand_tx);
+        {
+          // offer: 'Z' + the two ring-file paths; decline: 'Z' + 0 blobs
+          uint64_t zpay = 5;
+          if (offered)
+            zpay += 8 + c2h_path.size() + 8 + h2c_path.size();
+          std::vector<unsigned char> zb(8 + size_t(zpay));
+          be64_encode(zpay, zb.data());
+          zb[8] = 'Z';
+          be32_encode(offered ? 2u : 0u, zb.data() + 9);
+          if (offered) {
+            unsigned char* p = zb.data() + 13;
+            be64_encode(c2h_path.size(), p);
+            p += 8;
+            std::memcpy(p, c2h_path.data(), c2h_path.size());
+            p += c2h_path.size();
+            be64_encode(h2c_path.size(), p);
+            p += 8;
+            std::memcpy(p, h2c_path.data(), h2c_path.size());
+          }
+          if (!io_write_all(io, zb.data(), zb.size())) {
+            if (offered) {
+              ::unlink(c2h_path.c_str());
+              ::unlink(h2c_path.c_str());
+            }
+            break;
+          }
+        }
+        if (!offered) continue;  // declined: the connection stays pure TCP
+        // the next TCP frame MUST be the client's 'Z' confirm
+        bool ok = ensure(8);
+        bool attached = false;
+        if (ok) {
+          uint64_t n2 = be64_decode(rx.data() + rx_begin);
+          ok = n2 >= 5 && n2 <= max_payload_ && ensure(8 + size_t(n2));
+          if (ok) {
+            const unsigned char* p2 = rx.data() + rx_begin + 8;
+            rx_begin += 8 + size_t(n2);
+            ok = char(p2[0]) == 'Z' && parse_blob_table(p2, n2, blobs) &&
+                 blobs.size() == 1 && blobs[0].second == 1;
+            attached = ok && blobs[0].first[0] == 1;
+          }
+        }
+        // ring files are transient rendezvous: unlink as soon as the
+        // handshake resolves — live mappings keep the memory alive
+        ::unlink(c2h_path.c_str());
+        ::unlink(h2c_path.c_str());
+        if (!ok) break;  // torn handshake: drop peer (rings unmap + wake)
+        if (attached) {
+          if (rx_end != rx_begin) break;  // frames batched past the confirm
+          shm_rx = std::move(cand_rx);
+          shm_tx = std::move(cand_tx);
+          io.rx = shm_rx.get();
+          io.tx = shm_tx.get();
+        }
+        // confirm=0 (client mmap failed): rings destruct, stay on TCP
 
       } else {  // 'B' or unknown -> close
         break;
@@ -2302,6 +2640,10 @@ class ParameterServer {
   std::atomic<bool> stopped_{false};
   std::mutex stop_mtx_;  // serializes concurrent stop() teardowns (join is UB twice)
   std::thread replica_thread_;
+
+  // -- shm transport (ISSUE 18) -----------------------------------------------
+  std::string shm_dir_;               // empty = never offer the 'Z' attach
+  std::atomic<uint64_t> shm_seq_{0};  // ring-file name uniquifier
 
   // -- serving ----------------------------------------------------------------
   std::atomic<bool> running_{false};
@@ -2432,5 +2774,47 @@ void dk_ps_restore(void* ps, const float* flat, int64_t clock,
   static_cast<ParameterServer*>(ps)->restore(flat, clock, num_updates);
 }
 void dk_ps_destroy(void* ps) { delete static_cast<ParameterServer*>(ps); }
+
+// -- shm transport (ISSUE 18) -------------------------------------------------
+// enable the hub's 'Z' attach path: rings are created under `dir` (empty
+// or NULL disables).  Must be called before dk_ps_start.
+void dk_ps_shm_attach(void* ps, const char* dir) {
+  static_cast<ParameterServer*>(ps)->set_shm_dir(dir);
+}
+
+// standalone ring handles: the TSAN stress legs and the cross-language
+// layout pin drive the EXACT ring code the hub serves with
+void* dk_shm_ring_create(const char* path, int producer, uint64_t capacity) {
+  return ShmRing::create(path, producer != 0, capacity);
+}
+void* dk_shm_ring_open(const char* path, int producer) {
+  return ShmRing::open_existing(path, producer != 0);
+}
+// sendall semantics: n on success, -1 on peer-gone/timeout
+long long dk_shm_ring_write(void* ring, const void* buf, long long n,
+                            int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(ring);
+  return r->write(static_cast<const unsigned char*>(buf), size_t(n),
+                  timeout_ms, nullptr)
+             ? n
+             : -1;
+}
+// recv semantics: bytes read, 0 = clean EOF (producer closed + drained),
+// -1 = timeout
+long long dk_shm_ring_read(void* ring, void* buf, long long cap,
+                           int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(ring);
+  return (long long)r->read_some(static_cast<unsigned char*>(buf),
+                                 size_t(cap), timeout_ms, nullptr, nullptr);
+}
+// raise only THIS side's closed flag (peer drains then sees EOF /
+// peer-gone); the mapping stays valid until dk_shm_ring_destroy
+void dk_shm_ring_close(void* ring) {
+  auto* r = static_cast<ShmRing*>(ring);
+  (r->producer_ ? r->producer_closed_ : r->consumer_closed_)
+      ->store(1, std::memory_order_release);
+}
+// raise BOTH flags (wake a parked peer), unmap, free the handle
+void dk_shm_ring_destroy(void* ring) { delete static_cast<ShmRing*>(ring); }
 
 }  // extern "C"

@@ -4,10 +4,12 @@
 // slow/tsan-marked cell in tests/test_analysis.py, then run: a
 // sparse+adaptive primary with a hot-standby replica, hammered
 // concurrently by inproc committers, raw-socket pull/commit clients, a
-// sparse S/V/U client, a G/Y backpressure client, M health reports and
-// a telemetry poller — every production path of the native hub under
-// one data-race microscope.  Any TSAN report fails the test (the cell
-// runs with TSAN_OPTIONS=exitcode=66 and greps stderr).
+// sparse S/V/U client, a G/Y backpressure client, M health reports, a
+// telemetry poller, two shm-ring clients ('Z' handshake then P/C over
+// shared memory, ISSUE 18) and a raw SPSC ring producer/consumer pair —
+// every production path of the native hub under one data-race
+// microscope.  Any TSAN report fails the test (the cell runs with
+// TSAN_OPTIONS=exitcode=66 and greps stderr).
 //
 // The driver only uses the extern "C" API plus the public wire format
 // (frames byte-identical to networking.encode_tensors), so it compiles
@@ -23,6 +25,7 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -52,6 +55,16 @@ int64_t dk_ps_time_ns(void* ps);
 int dk_ps_wait_synced(void* ps, int64_t timeout_ms);
 int dk_ps_promoted(void* ps);
 void dk_ps_destroy(void* ps);
+// shm transport (ISSUE 18)
+void dk_ps_shm_attach(void* ps, const char* dir);
+void* dk_shm_ring_create(const char* path, int producer, uint64_t capacity);
+void* dk_shm_ring_open(const char* path, int producer);
+long long dk_shm_ring_write(void* ring, const void* buf, long long n,
+                            int timeout_ms);
+long long dk_shm_ring_read(void* ring, void* buf, long long cap,
+                           int timeout_ms);
+void dk_shm_ring_close(void* ring);
+void dk_shm_ring_destroy(void* ring);
 }
 
 namespace {
@@ -120,6 +133,48 @@ bool recv_frame_action(int fd, char* action) {
   if (len < 5 || len > (64u << 20)) return false;
   std::vector<char> payload(len);
   if (!recv_all(fd, payload.data(), len)) return false;
+  *action = payload[0];
+  return true;
+}
+
+// receive one frame keeping the whole payload (action + count + blobs) —
+// the 'Z' handshake needs the offer's path blobs, not just the action byte
+bool recv_frame(int fd, std::string* payload) {
+  char hdr[8];
+  if (!recv_all(fd, hdr, 8)) return false;
+  uint64_t len = 0;
+  for (int i = 0; i < 8; ++i) len = (len << 8) | uint8_t(hdr[i]);
+  if (len < 5 || len > (64u << 20)) return false;
+  payload->resize(len);
+  return recv_all(fd, &(*payload)[0], len);
+}
+
+// -- shm ring helpers (dk_shm_ring_* extern "C" surface) ---------------------
+
+bool ring_send_all(void* ring, const std::string& data) {
+  return dk_shm_ring_write(ring, data.data(), (long long)data.size(), 5000) ==
+         (long long)data.size();
+}
+
+bool ring_recv_all(void* ring, char* out, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    long long r = dk_shm_ring_read(ring, out + off, (long long)(n - off), 5000);
+    if (r <= 0) return false;
+    off += size_t(r);
+  }
+  return true;
+}
+
+// ring twin of recv_frame_action: one frame off the ring, payload discarded
+bool ring_recv_frame_action(void* ring, char* action) {
+  char hdr[8];
+  if (!ring_recv_all(ring, hdr, 8)) return false;
+  uint64_t len = 0;
+  for (int i = 0; i < 8; ++i) len = (len << 8) | uint8_t(hdr[i]);
+  if (len < 5 || len > (64u << 20)) return false;
+  std::vector<char> payload(len);
+  if (!ring_recv_all(ring, payload.data(), len)) return false;
   *action = payload[0];
   return true;
 }
@@ -224,6 +279,114 @@ void backpressure_leg(int port) {
   }
 }
 
+// Raw SPSC ring under TSAN: a producer thread streaming a byte counter
+// through a deliberately tiny ring (forcing wraparound and ring-full parks)
+// while this thread consumes and verifies the sequence, then EOF via
+// dk_shm_ring_close.  Exercises the head/tail acquire/release protocol and
+// the closed-flag wakeups with no hub in the loop.
+void ring_pair_leg(const std::string& path) {
+  void* prod = dk_shm_ring_create(path.c_str(), /*producer=*/1,
+                                  /*capacity=*/1 << 12);
+  if (!prod) return fail("ring_pair create");
+  void* cons = dk_shm_ring_open(path.c_str(), /*producer=*/0);
+  if (!cons) {
+    dk_shm_ring_destroy(prod);
+    return fail("ring_pair open");
+  }
+  ::unlink(path.c_str());  // mappings keep the memory alive
+  std::atomic<uint64_t> sent{0};
+  std::thread producer([&] {
+    char chunk[777];  // odd size so frames straddle the wrap point
+    uint64_t seq = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      for (auto& c : chunk) c = char(seq++ & 0xff);
+      if (dk_shm_ring_write(prod, chunk, sizeof(chunk), 5000) < 0)
+        return fail("ring_pair write");
+      sent.fetch_add(sizeof(chunk));
+    }
+    dk_shm_ring_close(prod);  // producer EOF wakes the parked consumer
+  });
+  char buf[1024];
+  uint64_t got = 0, expect = 0;
+  bool ok = true;
+  for (;;) {
+    long long r = dk_shm_ring_read(cons, buf, sizeof(buf), 5000);
+    if (r <= 0) break;  // 0 = producer closed and drained
+    for (long long i = 0; i < r; ++i)
+      if (uint8_t(buf[i]) != uint8_t(expect++ & 0xff)) ok = false;
+    got += uint64_t(r);
+  }
+  producer.join();
+  if (!ok) fail("ring_pair byte mismatch");
+  if (got != sent.load()) fail("ring_pair byte count");
+  dk_shm_ring_destroy(cons);
+  dk_shm_ring_destroy(prod);
+}
+
+// Full 'Z' handshake client: negotiate rings over TCP, then run the same
+// P/C traffic as socket_leg with every frame crossing shared memory — the
+// hub's ring producer racing our consumer (and vice versa) under TSAN.
+void shm_leg(int port) {
+  int fd = dial(port);
+  if (fd < 0) return fail("shm_leg dial");
+  std::string req(1, '\x01');  // SHM_VERSION
+  put_u64(req, 1 << 16);       // capacity hint
+  std::string offer;
+  if (!send_all(fd, frame('Z', {req})) || !recv_frame(fd, &offer) ||
+      offer[0] != 'Z') {
+    ::close(fd);
+    return fail("shm_leg handshake");
+  }
+  uint32_t count = 0;
+  for (int i = 1; i <= 4; ++i) count = (count << 8) | uint8_t(offer[i]);
+  if (count != 2) {  // 0 blobs = hub declined; shm_dir was attached, so fail
+    ::close(fd);
+    return fail("shm_leg declined");
+  }
+  std::string paths[2];  // [0]=c2h (we produce), [1]=h2c (we consume)
+  size_t off = 5;
+  for (int b = 0; b < 2; ++b) {
+    uint64_t blen = 0;
+    for (int i = 0; i < 8; ++i) blen = (blen << 8) | uint8_t(offer[off + i]);
+    off += 8;
+    paths[b] = offer.substr(off, blen);
+    off += blen;
+  }
+  void* tx = dk_shm_ring_open(paths[0].c_str(), /*producer=*/1);
+  void* rx = dk_shm_ring_open(paths[1].c_str(), /*producer=*/0);
+  if (!tx || !rx) {
+    send_all(fd, frame('Z', {std::string(1, '\x00')}));
+    if (tx) dk_shm_ring_destroy(tx);
+    if (rx) dk_shm_ring_destroy(rx);
+    ::close(fd);
+    return fail("shm_leg ring open");
+  }
+  if (!send_all(fd, frame('Z', {std::string(1, '\x01')}))) {
+    dk_shm_ring_destroy(tx);
+    dk_shm_ring_destroy(rx);
+    ::close(fd);
+    return fail("shm_leg confirm");
+  }
+  const std::string pull = frame('P', {});
+  const std::string commit =
+      frame('C', {f32_blob(std::vector<float>(kSizes[0], 1e-3f)),
+                  f32_blob(std::vector<float>(size_t(kSizes[1]), 1e-3f))});
+  char action = 0;
+  while (!g_stop.load(std::memory_order_relaxed)) {
+    if (!ring_send_all(tx, pull) || !ring_recv_frame_action(rx, &action) ||
+        action != 'W')
+      break;  // hub stopping under us is fine mid-run
+    if (!ring_send_all(tx, commit) || !ring_recv_frame_action(rx, &action) ||
+        action != 'A')
+      break;
+  }
+  ring_send_all(tx, frame('B', {}));
+  dk_shm_ring_close(tx);  // producer EOF so the hub handler exits cleanly
+  dk_shm_ring_destroy(tx);
+  dk_shm_ring_destroy(rx);
+  ::close(fd);
+}
+
 void telemetry_leg(void* ps) {
   int64_t stats[32], hist[65], recs[5 * 64];  // 26 StatSlots, 5-wide records
   unsigned char health[4096];
@@ -244,10 +407,19 @@ void telemetry_leg(void* ps) {
 }  // namespace
 
 int main() {
+  char shm_template[] = "/dev/shm/dktsanXXXXXX";
+  char tmp_template[] = "/tmp/dktsanXXXXXX";
+  char* shm_dir = ::mkdtemp(shm_template);
+  if (!shm_dir) shm_dir = ::mkdtemp(tmp_template);
+  if (!shm_dir) {
+    std::fprintf(stderr, "driver error: mkdtemp failed\n");
+    return 2;
+  }
   void* primary = dk_ps_create(0, 2, kSizes, /*mode=*/0, /*num_workers=*/4,
                                /*elastic=*/1, /*idle_timeout_ms=*/0,
                                /*num_sparse=*/1, kSparseLeaves, kSparseDims,
                                /*adaptive=*/1, /*max_payload=*/1 << 20);
+  dk_ps_shm_attach(primary, shm_dir);  // enables the 'Z' arm for shm_leg
   int port = dk_ps_start(primary);
   if (port <= 0) {
     std::fprintf(stderr, "driver error: primary failed to bind\n");
@@ -271,6 +443,10 @@ int main() {
   threads.emplace_back(sparse_leg, port);
   threads.emplace_back(backpressure_leg, port);
   threads.emplace_back(telemetry_leg, primary);
+  threads.emplace_back(shm_leg, port);
+  threads.emplace_back(shm_leg, port);  // two shm attaches racing one hub
+  threads.emplace_back(ring_pair_leg,
+                       std::string(shm_dir) + "/ring-pair.raw");
 
   if (dk_ps_wait_synced(standby, 5000) != 1) fail("standby never synced");
   std::this_thread::sleep_for(std::chrono::milliseconds(1500));
@@ -282,6 +458,7 @@ int main() {
   dk_ps_stop(primary);
   dk_ps_destroy(standby);
   dk_ps_destroy(primary);
+  ::rmdir(shm_dir);  // ring files were unlinked at handshake/creation time
   if (g_errors.load() != 0) return 3;
   std::printf("tsan stress complete\n");
   return 0;

@@ -1115,6 +1115,25 @@ def test_protocol_standby_model_checks_promotion():
     assert any("unreachable-promotion" in f.message for f in findings)
 
 
+def test_protocol_shm_attach_model_checks_handshake():
+    """The shm attach machine (ISSUE 18): the shipped rules settle every
+    hub generation untorn, and flipping each safety rule produces its
+    named failure — stranded replies, torn attaches, dead ring peers."""
+    assert not protocol_model.explore_shm()
+    for rule, needle in (
+            ("reply_before_switch", "stranded-reply"),
+            ("switch_requires_confirm", "torn-attach"),
+            ("decline_keeps_tcp", "torn-attach"),
+            ("abort_keeps_tcp", "torn-attach"),
+            ("legacy_close_is_decline", "torn-attach"),
+            ("sever_wakes_ring_peer", "dead-ring-peer")):
+        rules = dict(protocol_model.SHM_RULES)
+        rules[rule] = False
+        findings = protocol_model.explore_shm(rules=rules)
+        assert any(needle in f.message for f in findings), \
+            f"flipping {rule} produced no {needle} finding"
+
+
 def test_protocol_model_covers_full_registry():
     """Every registered ACTION_* byte is either a modeled request or a
     modeled reply — a 17th action must extend the model in the same PR

@@ -230,6 +230,83 @@ def test_async_acceptance_block_tripwires():
     assert acc2["final_loss_parity"] is None
 
 
+def test_async_transport_acceptance_tripwires():
+    """The ISSUE-18 zero-copy tripwires: shm-ring per-window wall must
+    beat the inproc direct pair, and the recv_batch hub must have served
+    more than one frame per blocking fill — None-degrading like every
+    other acceptance boolean."""
+    out = {
+        "async_adag_inproc": {"per_window_wall_ms": 40.0},
+        "shm_ring": {"per_window_wall_ms": 38.0},
+        "recv_batch": {"per_window_wall_ms": 41.0, "decomposition": {
+            "recv_batch_depth": {"count": 6, "mean": 2.5, "max": 4}}},
+    }
+    bench._async_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["shm_vs_inproc_per_window"] == 0.95
+    assert acc["shm_beats_inproc_direct_ok"] is True
+    assert acc["batch_syscalls_ok"] is True
+
+    # a slower ring trips the wire; a depth that never batched trips too
+    out2 = {
+        "async_adag_inproc": {"per_window_wall_ms": 40.0},
+        "shm_ring": {"per_window_wall_ms": 44.0},
+        "recv_batch": {"per_window_wall_ms": 41.0, "decomposition": {
+            "recv_batch_depth": {"count": 6, "mean": 1.0, "max": 1}}},
+    }
+    bench._async_acceptance(out2)
+    assert out2["acceptance"]["shm_beats_inproc_direct_ok"] is False
+    assert out2["acceptance"]["batch_syscalls_ok"] is False
+
+    # dead/missing legs degrade to None, not a KeyError
+    out3 = {"shm_ring": {"error": "OSError: /dev/shm full"},
+            "recv_batch": {"per_window_wall_ms": 41.0}}
+    bench._async_acceptance(out3)
+    assert out3["acceptance"]["shm_vs_inproc_per_window"] is None
+    assert out3["acceptance"]["shm_beats_inproc_direct_ok"] is None
+    assert out3["acceptance"]["batch_syscalls_ok"] is None
+
+
+@pytest.mark.slow  # trains real (tiny) models; the full suite runs it
+def test_bench_async_transport_legs_tiny_e2e():
+    """The evidence sources the shm_ring/recv_batch bench legs consume,
+    end to end at toy scale: an shm run moves frames over the rings
+    (ps.shm_frames_total), and a batched hub records its frames-per-fill
+    histogram (ps_recv_batch_depth) — the batch tripwire's input."""
+    import numpy as np
+
+    from distkeras_tpu import observability as obs
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+
+    spec = ModelSpec(name="mlp",
+                     config={"hidden_sizes": (8,), "num_outputs": 2},
+                     input_shape=(4,))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=64)
+    ds = Dataset({"features": x, "label": np.eye(2, dtype=np.float32)[y]})
+    kwargs = dict(loss="categorical_crossentropy", batch_size=16,
+                  num_epoch=1, num_workers=2, communication_window=2,
+                  learning_rate=0.05, seed=0)
+    obs.reset()
+    obs.enable()
+    try:
+        AsyncADAG(Model.init(spec, seed=0), transport="shm",
+                  **kwargs).train(ds)
+        snap = obs.snapshot()
+        assert snap["counters"].get("ps.shm_frames_total", 0) > 0
+        obs.reset()
+        AsyncADAG(Model.init(spec, seed=0), recv_batch_depth=8,
+                  **kwargs).train(ds)
+        hist = obs.snapshot()["histograms"].get("ps_recv_batch_depth")
+        assert hist is not None and hist["count"] >= 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
 def test_async_shard_acceptance_block_tripwires():
     """The ISSUE-6 shard-scaling tripwire: >= 3x aggregate commit
     throughput at 4 shards vs 1, None-degrading (the PR-3 convention)

@@ -569,6 +569,38 @@ def test_render_top_empty_is_safe():
     assert "0 worker(s)" in frame
 
 
+def test_transport_meta_folds_and_renders_trans_column():
+    """ISSUE 18: a report's ``transport`` field lands in worker meta,
+    shows up in distkeras-top's TRANS column, and feeds fleet_report's
+    transport block; workers that never report one render "-" and a
+    transport-free fleet carries no block at all."""
+    from distkeras_tpu.observability.distributed import fleet_report
+
+    c = HealthCollector()
+    c.ingest({"worker": "0", "transport": "shm",
+              "metrics": {"windows_total": 3.0}})
+    c.ingest({"worker": "1", "transport": "tcp",
+              "metrics": {"windows_total": 3.0}})
+    c.ingest({"worker": "2", "metrics": {"windows_total": 1.0}})
+    assert c.meta("0")["transport"] == "shm"
+    assert "transport" not in c.meta("2")
+    frame = render_top({"fleet": c.snapshot(), "events": []})
+    assert "TRANS" in frame.splitlines()[1]
+    rows = {line.split()[0]: line for line in frame.splitlines()[2:]}
+    assert rows["0"].split()[2] == "shm"
+    assert rows["1"].split()[2] == "tcp"
+    assert rows["2"].split()[2] == "-"
+
+    report = fleet_report(events=[], live=c)
+    assert report["transport"] == {
+        "workers": {"0": "shm", "1": "tcp"},
+        "counts": {"shm": 1, "tcp": 1}}
+    # absent-case byte-identity: no transport meta -> no block
+    c2 = HealthCollector()
+    c2.ingest({"worker": "0", "metrics": {"windows_total": 1.0}})
+    assert "transport" not in fleet_report(events=[], live=c2)
+
+
 # -- punchcard pull + console e2e ---------------------------------------------
 
 def test_punchcard_health_pull_and_top_console(telemetry, capsys):

@@ -695,6 +695,92 @@ def test_native_never_synced_standby_refuses_traffic():
         stand.stop()
 
 
+# -- zero-copy transport (ISSUE 18) --------------------------------------------
+
+def test_native_shm_attach_center_matches_tcp(tmp_path):
+    """A shm=True PSClient negotiates rings with the C++ hub ('Z' arm,
+    dk_ps_shm_attach) and the resulting center is identical to the same
+    session over plain TCP; ring files are unlinked after the attach."""
+    import os
+
+    results = {}
+    for shm in (False, True):
+        ps = NativeParameterServer(_weights(), mode=MODE_DELTA,
+                                   shm_dir=str(tmp_path))
+        ps.start()
+        try:
+            with PSClient("127.0.0.1", ps.port, templates=_weights(),
+                          shm=shm) as c:
+                assert c.transport == ("shm" if shm else "tcp")
+                c.pull()
+                for _ in range(3):
+                    c.commit([np.full((2, 2), 0.25, np.float32),
+                              np.full((3,), 0.5, np.float32)])
+                results[shm] = [w.copy() for w in c.pull()]
+            assert ps.num_updates == 3
+        finally:
+            ps.stop()
+    for x, y in zip(results[False], results[True]):
+        np.testing.assert_array_equal(x, y)
+    assert [f for f in os.listdir(str(tmp_path))
+            if f.startswith("ring-")] == []
+
+
+def test_cross_language_ring_byte_identical(tmp_path):
+    """THE cross-language ring pin: bytes written by the C++ ring
+    implementation read back identically through the Python one and vice
+    versa, including EOF propagation — the two layouts are one layout."""
+    import ctypes
+
+    from distkeras_tpu.runtime import native as native_mod
+    from distkeras_tpu.runtime import networking as net
+
+    lib = native_mod._load()
+    payload = bytes(range(256)) * 5  # 1280 B: wraps a 4 KiB ring
+
+    # C++ producer -> Python consumer
+    cpp_path = str(tmp_path / "cpp-ring").encode("utf-8")
+    handle = lib.dk_shm_ring_create(cpp_path, 1, 4096)
+    assert handle
+    py_cons = net.ShmFrameRing.open(cpp_path.decode("utf-8"), "consumer")
+    got = bytearray()
+    buf = bytearray(512)
+    for _ in range(4):
+        assert lib.dk_shm_ring_write(handle, payload, len(payload),
+                                     2000) == len(payload)
+        want = len(got) + len(payload)
+        while len(got) < want:
+            n = py_cons.read_into(memoryview(buf), timeout=2.0)
+            assert n > 0
+            got += buf[:n]
+    assert bytes(got) == payload * 4
+    lib.dk_shm_ring_close(handle)  # producer EOF
+    assert py_cons.read_into(memoryview(buf), timeout=2.0) == 0
+    lib.dk_shm_ring_destroy(handle)
+    py_cons.close()
+
+    # Python producer -> C++ consumer
+    py_path = str(tmp_path / "py-ring")
+    py_prod = net.ShmFrameRing.create(py_path, "producer", capacity=4096)
+    chandle = lib.dk_shm_ring_open(py_path.encode("utf-8"), 0)
+    assert chandle
+    writer = threading.Thread(
+        target=lambda: [py_prod.write(payload, timeout=2.0)
+                        for _ in range(4)] and None)
+    writer.start()
+    got2 = bytearray()
+    cbuf = ctypes.create_string_buffer(512)
+    while len(got2) < 4 * len(payload):
+        n = lib.dk_shm_ring_read(chandle, cbuf, 512, 2000)
+        assert n > 0
+        got2 += cbuf.raw[:n]
+    writer.join()
+    assert bytes(got2) == payload * 4
+    py_prod.close()  # EOF crosses the language boundary too
+    assert lib.dk_shm_ring_read(chandle, cbuf, 512, 2000) == 0
+    lib.dk_shm_ring_destroy(chandle)
+
+
 # -- guidance + hygiene --------------------------------------------------------
 
 def test_sparse_direct_pair_served_by_native_hub():

@@ -175,6 +175,50 @@ def test_facade_lifecycle_weights_and_direct_transport():
         ps.stop()
 
 
+def test_parallel_direct_pool_matches_sequential_walk():
+    """The per-shard worker pool (ISSUE 18) changes WHERE each stripe
+    runs, not what it computes: parallel_direct=True fans the stripes out
+    to one long-lived dk-shard-worker thread per shard, and the results
+    stay bit-identical to the sequential walk because the shards are
+    disjoint state."""
+    import threading
+
+    t = _templates()
+    rng = np.random.default_rng(7)
+    deltas = [[rng.normal(size=a.shape).astype(np.float32) for a in t]
+              for _ in range(3)]
+
+    def run(parallel):
+        plan = shard_plan(t, 3)
+        ps = ShardedParameterServer(
+            t, plan,
+            lambda w, sid: DeltaParameterServer(w, shard_id=sid,
+                                                idle_timeout=None),
+            parallel_direct=parallel)
+        ps.start()
+        try:
+            if parallel:
+                assert ps._pool is not None and ps._pool.running
+                names = {th.name for th in threading.enumerate()}
+                assert {f"dk-shard-worker-{i}" for i in range(3)} <= names
+            else:
+                assert ps._pool is None
+            for d in deltas:
+                _, clocks = ps.pull_direct()
+                ps.commit_direct(d, clocks)
+            assert ps.num_updates == len(deltas)
+            return [w.copy() for w in ps.get_weights()]
+        finally:
+            ps.stop()
+
+    pooled, sequential = run(True), run(False)
+    for a, b in zip(pooled, sequential):
+        np.testing.assert_array_equal(a, b)
+    # the pool threads are reaped on stop()
+    assert not any(th.name.startswith("dk-shard-worker")
+                   for th in threading.enumerate())
+
+
 def test_striped_client_pull_commit_and_int8_parity():
     """The striped socket client lands values identical to an unsharded
     client over the same math — including int8 error-feedback commits,

@@ -100,6 +100,66 @@ def test_inproc_rejects_worker_only_mode():
         dk.AsyncADAG(_mlp_spec(), transport="carrier-pigeon")
 
 
+# -- shared-memory transport (ISSUE 18) ----------------------------------------
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_shm_matches_socket_bit_identical(pipeline, toy_dataset):
+    """transport="shm" carries the SAME framed bytes over mmap rings, so
+    single-worker trajectories are bit-equal to socket runs.  The counter
+    assertion guards against the attach silently declining — a run that
+    degraded to TCP would pass the parity check vacuously."""
+    obs.reset()
+    obs.enable()
+    try:
+        shm = _train("AsyncADAG", toy_dataset, transport="shm",
+                     pipeline=pipeline)
+        counters = obs.snapshot()["counters"]
+        assert counters.get("ps.shm_frames_total", 0) > 0, \
+            "shm run silently fell back to TCP"
+    finally:
+        obs.disable()
+        obs.reset()
+    sock = _train("AsyncADAG", toy_dataset, transport="socket",
+                  pipeline=pipeline)
+    _assert_bit_identical(sock, shm)
+
+
+@pytest.mark.slow  # full-suite coverage; tier-1 keeps the f32 parity pins
+def test_shm_matches_socket_with_int8_commits(toy_dataset):
+    """Quantized commits cross the rings bit-identically too, and a
+    batched-receive hub (recv_batch_depth) changes syscall shape only —
+    all three runs land on the same trajectory."""
+    sock = _train("AsyncADAG", toy_dataset, transport="socket",
+                  pipeline=True, compress_commits="int8")
+    batched = _train("AsyncADAG", toy_dataset, transport="socket",
+                     pipeline=True, compress_commits="int8",
+                     recv_batch_depth=8)
+    shm = _train("AsyncADAG", toy_dataset, transport="shm", pipeline=True,
+                 compress_commits="int8")
+    _assert_bit_identical(sock, batched)
+    _assert_bit_identical(sock, shm)
+
+
+def test_recv_batch_depth_matches_plain_socket_bit_identical(toy_dataset):
+    """The hub's batched receive path (recvmmsg when available, plain
+    nonblocking drains otherwise) parses the same stream — trajectories
+    are bit-equal to the unbatched hub."""
+    plain = _train("AsyncADAG", toy_dataset, transport="socket",
+                   pipeline=True)
+    batched = _train("AsyncADAG", toy_dataset, transport="socket",
+                     pipeline=True, recv_batch_depth=8)
+    _assert_bit_identical(plain, batched)
+
+
+def test_shm_transport_validation():
+    import distkeras_tpu as dk
+
+    tr = dk.AsyncADAG(_mlp_spec(), transport="shm")
+    assert tr.transport == "shm"
+    with pytest.raises(ValueError, match="recv_batch_depth"):
+        dk.AsyncADAG(_mlp_spec(), recv_batch_depth=-1)
+
+
 def test_pipelined_prefetch_semantics_and_staleness_accounting(toy_dataset):
     """Pipelining's documented semantics (ARCHITECTURE.md "Async
     transport"): the pull for window k+1 is issued BEFORE commit k, so the
