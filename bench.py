@@ -2047,6 +2047,11 @@ def _bench_async_recovery(*, workers: int = 2, window: int = 8, batch: int = 256
     except Exception as ex:
         out["adaptive"] = {"error": f"{type(ex).__name__}: {ex}"}
 
+    try:
+        out["spot_preemption"] = _bench_async_spot_preemption()
+    except Exception as ex:
+        out["spot_preemption"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     _async_recovery_acceptance(out)
     return out
 
@@ -2222,6 +2227,106 @@ def _bench_async_adaptive(*, workers: int = 8, window: int = 4,
     return out
 
 
+def _bench_async_spot_preemption(*, workers: int = 6, preempt: int = 2,
+                                 window: int = 4, batch: int = 64,
+                                 windows_per_epoch: int = 6,
+                                 epochs: int = 3, deadline_s: float = 5.0):
+    """Issue-19 self-scaling leg: preempt ``preempt`` of ``workers``
+    workers mid-run with a planned :class:`SpotPreemptionPlan` notice
+    (SIGTERM-with-deadline semantics) under ``autoscale=True``.  Each
+    preempted worker drains gracefully — in-flight commits acked, BYE
+    sent — and the FleetController authorizes a budget-neutral respawn
+    against the hub's current center, with zero operator input.
+
+    Measures fleet throughput (windows/s from the trainer's window log)
+    BEFORE the first notice vs AFTER the last one: the
+    ``preemption_recovered_ok`` tripwire wants >= 90% restored.
+    ``drain_zero_loss_ok`` wants every drain clean with nothing left
+    unacked.  Cold timing (one compile inside the measured wall), so the
+    rates — not the wall — carry the verdict."""
+    import numpy as np
+
+    from distkeras_tpu.models.base import Model, ModelSpec
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.observability import health as health_mod
+    from distkeras_tpu.runtime.async_trainer import AsyncADAG
+    from distkeras_tpu.runtime.faults import SpotPreemptionPlan
+
+    spec = ModelSpec(name="mlp",
+                     config={"hidden_sizes": (32,), "num_outputs": 10},
+                     input_shape=(16,))
+    rng = np.random.default_rng(0)
+    n = workers * batch * window * windows_per_epoch
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)]
+    ds = Dataset({"features": x, "label": y})
+    # notices land on the LAST `preempt` workers, staggered one window
+    # apart, in the middle of epoch 1 — past compile, with room to
+    # measure the restored rate afterwards
+    mid = windows_per_epoch // 2
+    plan = SpotPreemptionPlan(
+        [(workers - 1 - i, mid + i) for i in range(preempt)],
+        deadline_s=deadline_s)
+    out = {"workers": workers, "preempt": preempt, "window": window,
+           "batch": batch, "epochs": epochs, "deadline_s": deadline_s}
+    health_mod.reset_default()
+    mon = health_mod.monitor()
+    old_cadence = (mon.check_interval_s, mon.cooldown_s)
+    mon.check_interval_s = 0.2
+    mon.cooldown_s = 0.5
+    try:
+        tr = AsyncADAG(Model.init(spec, seed=0),
+                       loss="categorical_crossentropy", batch_size=batch,
+                       num_epoch=epochs, learning_rate=0.05, seed=0,
+                       num_workers=workers, communication_window=window,
+                       elastic=True, autoscale=True,
+                       health_interval_s=0.25,
+                       on_worker_failure="restart", max_worker_restarts=1,
+                       fault_hook=plan.hook)
+        t0 = time.perf_counter()
+        tr.train(ds, shuffle=False)
+        wall = time.perf_counter() - t0
+    finally:
+        mon.check_interval_s, mon.cooldown_s = old_cadence
+        health_mod.reset_default()
+    log = sorted(tr._window_log)
+    fired_at = sorted(plan.fired_at)
+    pre_rate = post_rate = None
+    if log and fired_at:
+        t_start, t_end = log[0][0], log[-1][0]
+        t_pre, t_post = fired_at[0], fired_at[-1]
+        n_pre = sum(1 for ts, _ in log if ts < t_pre)
+        n_post = sum(1 for ts, _ in log if ts >= t_post)
+        if t_pre > t_start:
+            pre_rate = n_pre / (t_pre - t_start)
+        if t_end > t_post:
+            post_rate = n_post / (t_end - t_post)
+    stats = (tr.fleet_controller.stats()
+             if tr.fleet_controller is not None else {})
+    drains = list(tr.worker_preemptions)
+    out.update({
+        "timing": "cold-wall (one compile inside the measured wall)",
+        "wall_s": round(wall, 3),
+        "final_loss": (round(float(np.mean(tr.history[-8:])), 6)
+                       if tr.history else None),
+        "preemptions_fired": len(plan.fired),
+        "drains": drains,
+        "drains_clean": (all(d["drained_clean"] for d in drains)
+                         if drains else None),
+        "outstanding_after_drain": (max(d["outstanding_after_drain"]
+                                        for d in drains)
+                                    if drains else None),
+        "respawns": stats.get("preemptions", 0),
+        "pre_rate_windows_s": (round(pre_rate, 2)
+                               if pre_rate is not None else None),
+        "post_rate_windows_s": (round(post_rate, 2)
+                                if post_rate is not None else None),
+        "restarts": tr.worker_restarts,
+        "worker_errors": len(tr.worker_errors),
+    })
+    return out
+
+
 def _async_recovery_acceptance(out: dict) -> None:
     """Attach the issue-4 recovery tripwires, in place.  Booleans, or None
     when a denominator leg is missing/errored (graceful degradation,
@@ -2278,6 +2383,28 @@ def _async_recovery_acceptance(out: dict) -> None:
     if ad_adap is not None:
         ad_reacted = bool((ad_adap.get("merged_commits") or 0)
                           + (ad_adap.get("rate_scaled_commits") or 0) >= 1)
+    # issue-19 spot-preemption leg: every planned notice fired, every
+    # preempted worker drained and was respawned without operator input,
+    # and the fleet restored >= 90% of its pre-preemption throughput;
+    # drain_zero_loss separately pins that NOTHING acked was left behind
+    sp = out.get("spot_preemption", {})
+    sp_ok = sp if isinstance(sp, dict) and sp and "error" not in sp else None
+    sp_recovered = None
+    sp_zero_loss = None
+    if sp_ok is not None:
+        pre = sp_ok.get("pre_rate_windows_s")
+        post = sp_ok.get("post_rate_windows_s")
+        planned = int(sp_ok.get("preempt") or 0)
+        if pre and post is not None:
+            sp_recovered = bool(
+                sp_ok.get("preemptions_fired") == planned
+                and (sp_ok.get("respawns") or 0) >= planned
+                and post >= 0.9 * pre
+                and sp_ok.get("worker_errors") == 0)
+        sp_zero_loss = bool(
+            len(sp_ok.get("drains") or ()) == sp_ok.get("preemptions_fired")
+            and sp_ok.get("drains_clean") is True
+            and sp_ok.get("outstanding_after_drain") == 0)
     out["acceptance"] = {
         "sever_recovered_ok": (bool(out["sever"]["faults_fired"] >= 1
                                     and out["sever"]["reconnects"] >= 1)
@@ -2319,6 +2446,12 @@ def _async_recovery_acceptance(out: dict) -> None:
         "adaptive_wall_ratio": ad_ratio,
         "adaptive_beats_plain_ok": ad_beats,
         "adaptive_reacted_ok": ad_reacted,
+        "preemption_pre_rate_windows_s": (sp_ok.get("pre_rate_windows_s")
+                                          if sp_ok else None),
+        "preemption_post_rate_windows_s": (sp_ok.get("post_rate_windows_s")
+                                           if sp_ok else None),
+        "preemption_recovered_ok": sp_recovered,
+        "drain_zero_loss_ok": sp_zero_loss,
     }
 
 

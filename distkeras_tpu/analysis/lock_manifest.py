@@ -52,6 +52,10 @@ LOCK_ORDER = [
     "health._default_lock",  # lint: telemetry-ok lock node name, not a metric
     "HealthMonitor._lock",
     "HealthCollector._lock",
+    # fleet controller (ISSUE 19): a leaf — spawn/retire callbacks and
+    # telemetry run OUTSIDE it by contract (monitor callbacks arrive on
+    # emitting threads that may hold hub/trainer locks above)
+    "FleetController._lock",
     # leaf infrastructure: metrics registry and instruments, tracer, sinks
     "MetricsRegistry._lock",
     "SpanTracer._lock",
@@ -185,6 +189,18 @@ GUARDED_BY: Dict[str, Tuple[Optional[str], str]] = {
     "SocketParameterServer._conns": ("SocketParameterServer._conn_lock", ""),
     "SocketParameterServer._shm_seq":
         ("SocketParameterServer._conn_lock", ""),
+    # -- multi-job admission (ISSUE 19): namespaces, verdict counters and
+    #    every per-job center mutation settle under the center lock
+    "SocketParameterServer._jobs": ("SocketParameterServer._lock", ""),
+    "SocketParameterServer.jobs_admitted":
+        ("SocketParameterServer._lock", ""),
+    "SocketParameterServer.jobs_rejected":
+        ("SocketParameterServer._lock", ""),
+    # -- fleet controller (ISSUE 19): decision state under its leaf lock
+    "FleetController._last_spawn": ("FleetController._lock", ""),
+    "FleetController._spawns": ("FleetController._lock", ""),
+    "FleetController._retires": ("FleetController._lock", ""),
+    "FleetController._strikes": ("FleetController._lock", ""),
     # -- punchcard daemon
     "Punchcard._jobs": ("Punchcard._lock", ""),
     "Punchcard._lock_path": ("Punchcard._lock", ""),

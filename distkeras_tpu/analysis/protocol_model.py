@@ -88,7 +88,10 @@ REPLY_PRODUCERS: Dict[str, Tuple[str, ...]] = {
     "ACTION_WEIGHTS": ("ACTION_WEIGHTS",),
     "ACTION_ACK": ("ACTION_ACK",),
     "ACTION_SPARSE_WEIGHTS": ("ACTION_SPARSE_WEIGHTS",),
-    "ACTION_TRACE": ("encode_time_payload",),
+    # the T reply is the clock-sync timestamp, or (for a job-scoped
+    # announce, ISSUE 19) the admission verdict — either encoder in the
+    # handler body proves production
+    "ACTION_TRACE": ("encode_time_payload", "encode_admission_payload"),
     "ACTION_RETRY": ("encode_retry_payload",),
     "ACTION_REPL": ("ReplicationFeed", "attach"),
     "ACTION_SHM": ("ACTION_SHM",),
@@ -117,6 +120,38 @@ STANDBY_RULES: Dict[str, Any] = {
     # stream — never a frame kind it cannot parse (a torn stream)
     "sparse_delta_requires_cap": True,
 }
+
+#: The fleet join/drain/admission contract (ISSUE 19) as checkable
+#: flags.  A job-scoped session announces its namespace on the existing
+#: ``T`` trace frame (``job_ns`` key); the hub's admission verdict rides
+#: the ``T`` reply.  Planned preemption is SIGTERM-with-a-deadline: the
+#: worker finishes its in-flight commits, flushes residuals, sends
+#: ``B``, and only then does the controller detach it — membership
+#: churn is exactly where interleaving bugs live, so the machine is
+#: model-checked before (and independent of) the code.  Fixture tests
+#: flip these to seed drain-while-commit-in-flight and
+#: admission-reject-races-attach violations.
+FLEET_RULES: Dict[str, Any] = {
+    # the hub decides admission on the T announce, BEFORE serving any
+    # pull/commit on that connection — a verdict raced by an attach
+    # would let a to-be-rejected job observe (or move) center state
+    "admission_before_attach": True,
+    # a rejected connection is never served: any subsequent pull or
+    # commit is refused with a protocol error, not silently applied
+    "reject_never_serves": True,
+    # a draining worker sends BYE only after every in-flight commit is
+    # acked (and the int8 residual flush commit, if any, is one of
+    # them) — zero acked-commit loss across the drain
+    "drain_completes_inflight": True,
+    # a respawned replacement pulls the CURRENT center before its first
+    # commit — it must never commit a delta computed against the
+    # weights its predecessor died holding
+    "respawn_pulls_current_center": True,
+    # the controller detaches (membership-shrinks) a worker only after
+    # observing its drain complete — never mid-commit
+    "retire_after_drain_only": True,
+}
+
 
 #: The shm attach/decline/detach contract (ISSUE 18) as checkable flags.
 #: The handshake is three TCP frames — client ``Z`` request, hub ``Z``
@@ -634,6 +669,154 @@ def _explore_shm_gen(rules: Dict[str, Any], hub_gen: str) -> List[Finding]:
     return findings
 
 
+# -- bounded exploration: fleet join / drain / admission -----------------------
+
+def explore_fleet(rules: Optional[Dict[str, Any]] = None,
+                  max_commits: int = 2) -> List[Finding]:
+    """Exhaustive walk of one job-scoped session's lifecycle against the
+    hub + controller (ISSUE 19): T announce -> admission verdict ->
+    attach -> pipelined commits -> preemption notice -> drain -> BYE ->
+    detach, plus the respawned-replacement generation.  Checks:
+
+    - **admission-races-attach**: a pull/commit served before the
+      admission verdict settles;
+    - **post-reject-served**: a rejected session later served;
+    - **acked-commit-loss**: BYE leaves the worker while commits are
+      still in flight — the drain discards work the client believes
+      (or will believe) acked;
+    - **retire-before-drain**: the controller detaches a worker whose
+      drain has not completed;
+    - **respawn-blind-commit**: a respawned replacement commits before
+      pulling the current center;
+    - deadlock freedom: every explored path reaches a final state.
+
+    Both generations (fresh join, post-preemption respawn) are explored;
+    the respawn generation differs only in that its blind-commit
+    temptation is real (it holds its predecessor's stale weights).
+    """
+    rules = dict(FLEET_RULES if rules is None else rules)
+    findings: List[Finding] = []
+    for respawn in (False, True):
+        findings.extend(_explore_fleet_gen(rules, respawn, max_commits))
+        if len(findings) >= 8:
+            break
+    return findings
+
+
+def _explore_fleet_gen(rules: Dict[str, Any], respawn: bool,
+                       max_commits: int) -> List[Finding]:
+    findings: List[Finding] = []
+    # state: (phase, inflight, pulled, commits_left, draining);
+    # phases walk announced -> {admitted, rejected}; admitted ->
+    # active -> (drain) -> detached; rejected -> closed.  ``respawn``
+    # is immutable per walk (it parameterizes the generation the same
+    # way sparse_cap does for the standby machine).
+    init = ("announced", 0, False, max_commits, False)
+    seen = {init}
+    frontier: List[Tuple[Tuple, Tuple[str, ...]]] = [(init, ())]
+    detached_reachable = False
+    while frontier:
+        state, trace = frontier.pop()
+        phase, inflight, pulled, commits_left, draining = state
+        events: List[Tuple[str, Tuple]] = []
+        if phase == "announced":
+            events.append(("hub_admits",
+                           ("admitted", inflight, pulled, commits_left,
+                            draining)))
+            events.append(("hub_rejects",
+                           ("rejected", inflight, pulled, commits_left,
+                            draining)))
+            if not rules["admission_before_attach"]:
+                # a hub that attaches before the verdict settles serves
+                # a pull against a center the job may be refused
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"admission-races-attach: a pull is served while the "
+                    f"admission verdict is still pending — a "
+                    f"to-be-rejected job observed center state "
+                    f"(trace: {' -> '.join(trace + ('serve_before_verdict',))})"))
+        elif phase == "rejected":
+            if rules["reject_never_serves"]:
+                events.append(("reject_refused_close",
+                               ("closed", 0, pulled, 0, draining)))
+            else:
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"post-reject-served: a commit from a REJECTED session "
+                    f"is applied to the center — admission control is "
+                    f"advisory only "
+                    f"(trace: {' -> '.join(trace + ('serve_after_reject',))})"))
+        elif phase == "admitted":
+            events.append(("first_pull",
+                           ("active", inflight, True, commits_left,
+                            draining)))
+            if respawn and not rules["respawn_pulls_current_center"]:
+                findings.append(Finding(
+                    "protocol", SELF_PATH, 1,
+                    f"respawn-blind-commit: a respawned replacement "
+                    f"commits a delta computed against its predecessor's "
+                    f"stale weights — it must pull the current center "
+                    f"first "
+                    f"(trace: {' -> '.join(trace + ('commit_blind',))})"))
+        elif phase == "active":
+            if commits_left > 0 and inflight < 2 and not draining:
+                events.append(("commit_sent",
+                               ("active", inflight + 1, pulled,
+                                commits_left - 1, draining)))
+            if inflight > 0:
+                events.append(("commit_acked",
+                               ("active", inflight - 1, pulled,
+                                commits_left, draining)))
+            if not draining:
+                events.append(("preemption_notice",
+                               ("active", inflight, pulled, commits_left,
+                                True)))
+            if draining:
+                if inflight == 0 or not rules["drain_completes_inflight"]:
+                    if inflight > 0:
+                        findings.append(Finding(
+                            "protocol", SELF_PATH, 1,
+                            f"acked-commit-loss: BYE leaves the worker "
+                            f"with {inflight} commit(s) still in flight — "
+                            f"the drain discards work the hub may ack "
+                            f"into a torn session "
+                            f"(trace: "
+                            f"{' -> '.join(trace + ('bye_with_inflight',))})"))
+                    else:
+                        events.append(("drained_bye",
+                                       ("detached", 0, pulled, 0, True)))
+                if not rules["retire_after_drain_only"] and inflight > 0:
+                    findings.append(Finding(
+                        "protocol", SELF_PATH, 1,
+                        f"retire-before-drain: the controller detaches a "
+                        f"worker whose in-flight commit was never acked — "
+                        f"membership shrinks mid-commit "
+                        f"(trace: "
+                        f"{' -> '.join(trace + ('force_detach',))})"))
+        elif phase in ("detached", "closed"):
+            detached_reachable = True
+            continue  # final
+        if not events and phase not in ("detached", "closed") \
+                and not findings:
+            findings.append(Finding(
+                "protocol", SELF_PATH, 1,
+                f"fleet deadlock: no event enabled in phase {phase} "
+                f"(inflight={inflight}, draining={draining}) "
+                f"(trace: {' -> '.join(trace[-6:])})"))
+        for name, nstate in events:
+            if nstate not in seen:
+                seen.add(nstate)
+                frontier.append((nstate, trace + (name,)))
+        if len(findings) >= 8:
+            return findings
+    if not detached_reachable and not findings:
+        findings.append(Finding(
+            "protocol", SELF_PATH, 1,
+            f"fleet unreachable-detach: no interleaving (respawn="
+            f"{respawn}) ever completes the join/drain lifecycle"))
+    return findings
+
+
 # -- the pass ------------------------------------------------------------------
 
 def check(net_src: SourceFile, ps_src: SourceFile, root: str,
@@ -645,6 +828,7 @@ def check(net_src: SourceFile, ps_src: SourceFile, root: str,
     findings.extend(explore_sessions())
     findings.extend(explore_standby())
     findings.extend(explore_shm())
+    findings.extend(explore_fleet())
     return apply_annotations(findings, sources or {}, root, rule="protocol")
 
 

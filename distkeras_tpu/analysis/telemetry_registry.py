@@ -57,8 +57,13 @@ TELEMETRY_NAMES = frozenset({
     "ps.sparse_hot_rows",
     "ps_sparse_cache_hits_total", "ps_sparse_cache_misses_total",
     "ps.repl_sparse_bytes_saved",
+    # self-scaling fleet + multi-job admission (ISSUE 19): controller
+    # decisions, job namespace admission verdicts, live job count
+    "ps_fleet_spawns_total", "ps_fleet_retires_total",
+    "ps_fleet_preemptions_total", "ps_fleet_target_size",
+    "ps_jobs_admitted_total", "ps_jobs_rejected_total", "ps_active_jobs",
     # -- worker / health planes ------------------------------------------------
-    "worker.restarts",
+    "worker.restarts", "worker.preemptions",
     "health.event",
     # -- transport -------------------------------------------------------------
     "net_tx_frames_total", "net_tx_bytes_total",

@@ -400,6 +400,10 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
 
     workers: Dict[str, Dict[str, Any]] = {}
     shards: Dict[str, Dict[str, Any]] = {}
+    # multi-job hub (ISSUE 19): per-job commit attribution — the span
+    # "job" attr is the trace job id for default-namespace sessions and
+    # the admitted job namespace for job-scoped ones
+    jobs: Dict[str, Dict[str, Any]] = {}
     window_spans = 0
     commits_total = 0
     commits_with_ctx = 0
@@ -439,6 +443,13 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
                 # coverage stay logical-commit-denominated
                 continue
             commits_total += 1
+            job = attrs.get("job")
+            if job is not None:
+                jb = jobs.setdefault(str(job), {
+                    "commits": 0, "staleness_sum": 0, "commit_ms_sum": 0.0})
+                jb["commits"] += 1
+                jb["staleness_sum"] += stale
+                jb["commit_ms_sum"] += s.get("dur_us", 0) / 1000.0
             worker = attrs.get("worker")
             if worker is None or int(worker) < 0:
                 continue
@@ -508,6 +519,32 @@ def fleet_report(events: Optional[Iterable[Dict[str, Any]]] = None,
         report["shards"] = shards
         report["shards_ranked"] = shards_ranked
         report["slowest_shard"] = shards_ranked[0] if shards_ranked else None
+    if len(jobs) >= 2:
+        # per-job fairness (ISSUE 19), only when the hub actually served
+        # multiple jobs — single-job reports keep their exact prior shape.
+        # share = fraction of attributed hub commits: an admission-
+        # controlled hub should hold shares near each job's worker share,
+        # so a job starving the others is nameable from the report alone
+        attributed = sum(jb["commits"] for jb in jobs.values())
+        for jb in jobs.values():
+            jb["mean_staleness"] = (round(jb["staleness_sum"]
+                                          / jb["commits"], 3)
+                                    if jb["commits"] else None)
+            jb["mean_commit_ms"] = (round(jb["commit_ms_sum"]
+                                          / jb["commits"], 4)
+                                    if jb["commits"] else None)
+            jb["commit_ms_sum"] = round(jb["commit_ms_sum"], 3)
+            jb["share"] = (round(jb["commits"] / attributed, 4)
+                           if attributed else None)
+        shares = sorted(jobs, key=lambda j: jobs[j]["commits"],
+                        reverse=True)
+        report["jobs"] = {
+            "per_job": jobs,
+            "ranked": shares,
+            "dominant": shares[0] if shares else None,
+            "max_share": (jobs[shares[0]]["share"] if shares else None),
+            "min_share": (jobs[shares[-1]]["share"] if shares else None),
+        }
     live_snap = None
     if live is not None:
         try:

@@ -648,10 +648,17 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
     fleet = health.get("fleet") or {}
     workers = fleet.get("workers") or {}
     events = health.get("events") or []
+    # fleet size + job census (ISSUE 19): the title line says how many
+    # workers are live and how many distinct jobs they report under, so
+    # a multi-job hub's console names the tenancy at a glance
+    job_census = {str((e.get("meta") or {}).get("job"))
+                  for e in workers.values()
+                  if (e.get("meta") or {}).get("job") is not None}
     lines = [
-        f"distkeras-top — {len(workers)} worker(s), "
+        f"distkeras-top — fleet {len(workers)} worker(s), "
+        f"{len(job_census)} job(s), "
         f"{len(events)} event(s)  [{time.strftime('%H:%M:%S')}]",
-        f"{'WORKER':>8} {'SHARD':>5} {'TRANS':>6} {'WIN/S':>7} "
+        f"{'WORKER':>8} {'JOB':>10} {'SHARD':>5} {'TRANS':>6} {'WIN/S':>7} "
         f"{'WALL MS':>9} {'P95 MS':>9} {'STALE':>6} {'SCALE':>6} "
         f"{'RECON':>6} {'ROW/S':>8} {'HIT%':>5} {'RΔ/S':>8} {'MQ':>4} "
         f"{'AGE S':>6}",
@@ -689,8 +696,14 @@ def render_top(health: Dict[str, Any], width: int = 100) -> str:
             total = (hits or 0.0) + (misses or 0.0)
             hit_pct = (100.0 * (hits or 0.0) / total) if total else None
         repl = m.get("repl_sparse_bytes_total") or {}
+        # JOB (ISSUE 19): the job this worker reports under — the trace
+        # job id, or the admitted job namespace on a multi-job hub;
+        # truncated from the left so the distinguishing suffix survives
+        job = meta.get("job")
+        job_cell = ("-" if job is None
+                    else str(job)[-10:])
         lines.append(
-            f"{w:>8} {_fmt(meta.get('shard')):>5} "
+            f"{w:>8} {job_cell:>10} {_fmt(meta.get('shard')):>5} "
             # TRANS (ISSUE 18): the worker's PS transport — "shm" rows
             # are riding shared-memory rings, "tcp" plain sockets,
             # "inproc" the direct in-process path, "mixed" a sharded

@@ -49,7 +49,7 @@ import shutil
 import tempfile
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -71,8 +71,20 @@ from distkeras_tpu.runtime.parameter_server import (
     _normalize_failover,
     shard_plan,
 )
+from distkeras_tpu.runtime.faults import WorkerPreempted
 from distkeras_tpu.trainers import Trainer
 from distkeras_tpu.utils import flatten_weights
+
+
+class _DrainRequested(Exception):
+    """Control-flow signal: the FleetController asked this worker to
+    retire; unwinds the window loop into the graceful-drain handler."""
+
+    def __init__(self, worker: int, window: int):
+        super().__init__(
+            f"drain requested: worker {worker} at window {window}")
+        self.worker = int(worker)
+        self.window = int(window)
 
 
 def _make_window_fn(trainer: "AsyncDistributedTrainer", apply_fn: Callable,
@@ -132,6 +144,7 @@ class AsyncDistributedTrainer(Trainer):
                  sparse_tables: Optional[Any] = None,
                  sparse_cache_rows: Optional[int] = None,
                  adaptive: bool = False,
+                 autoscale: bool = False,
                  **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
@@ -387,11 +400,38 @@ class AsyncDistributedTrainer(Trainer):
         # both hubs serve adaptive=True: the C++ hub runs the Adasum
         # flat-combining merger and G/Y backpressure natively, with
         # per-worker rates pushed from the Python AdaptiveRateController
+        # self-scaling fleet (ISSUE 19), off by default.  On: a
+        # FleetController subscribes to the run's HealthMonitor and acts
+        # on capacity — respawning a worker slot when fleet throughput
+        # lags the frozen run-start baseline, retiring a worker the
+        # staleness_drift detector names persistently (graceful drain →
+        # BYE → elastic membership shrink), and authorizing the respawn
+        # after a planned preemption (SpotPreemptionPlan / SIGTERM-with-
+        # deadline) WITHOUT charging the restart budget.  Requires an
+        # owned hub with the health plane on (health_interval_s); the
+        # default False sends every wire byte identical to HEAD
+        self.autoscale = bool(autoscale)
+        if self.autoscale and ps_address is not None:
+            raise ValueError(
+                "autoscale=True requires a trainer-owned hub (the "
+                "controller subscribes to the owned run's HealthMonitor); "
+                "worker-only mode scales at the launcher instead "
+                "(distkeras-ps --autoscale)")
         # test/chaos hook: called as fault_hook(worker_idx, window_idx) at
         # every window boundary; raise inside it to kill that worker
         self.fault_hook = fault_hook
         self.worker_errors: List[BaseException] = []
         self.worker_restarts = 0  # total supervisor restarts, last train()
+        # planned-preemption records, last train(): one dict per drained
+        # worker ({"worker", "window", "deadline_s", "drained_clean",
+        # "outstanding_after_drain"}) — the recovery drill and the bench
+        # tripwires read these
+        self.worker_preemptions: List[Dict[str, Any]] = []
+        self.fleet_controller: Optional[Any] = None  # last train()'s, if any
+        # (monotonic_ts, worker) per completed window, autoscale runs only
+        # — the bench derives pre/post-preemption fleet throughput from it
+        self._window_log: List[Tuple[float, int]] = []
+        self._window_log_lock = threading.Lock()
         self.parameter_server: Optional[Any] = None
         self._window_fn: Optional[Callable] = None  # cached per instance so a
         # second train() on the same trainer reuses the compiled program
@@ -741,6 +781,51 @@ class AsyncDistributedTrainer(Trainer):
 
         restart_counts = [0] * self.num_workers
 
+        # self-scaling fleet (ISSUE 19): per-run control state shared by
+        # the worker threads and the controller callbacks.  fleet_lock
+        # exists even with autoscale off — the dynamic join below reads
+        # `threads` under it either way
+        self.worker_preemptions = []
+        with self._window_log_lock:
+            self._window_log = []
+        fleet_lock = threading.Lock()
+        drain_requests: set = set()   # worker idxs asked to retire
+        drained: set = set()          # worker idxs that drained clean
+        exited_workers: set = set()   # idxs whose threads returned (respawn pool)
+        controller = None
+        if self.autoscale:
+            from distkeras_tpu.observability import health as _health
+            from distkeras_tpu.runtime.fleet_controller import FleetController
+
+            def _spawn_replacement(_worker) -> None:
+                # replacement capacity re-enters through an EXITED worker
+                # slot (its dataset shard is otherwise orphaned); with
+                # the whole fleet live there is nothing to replace, so
+                # the decision stays advisory
+                with fleet_lock:
+                    if not exited_workers:
+                        return
+                    ridx = exited_workers.pop()
+                t = threading.Thread(target=run_worker, args=(ridx,))
+                with fleet_lock:
+                    threads.append(t)
+                t.start()
+
+            def _request_drain(worker: str) -> None:
+                try:
+                    widx = int(worker)
+                except (TypeError, ValueError):
+                    return
+                with fleet_lock:
+                    drain_requests.add(widx)
+
+            controller = FleetController(_health.monitor(),
+                                         spawn_fn=_spawn_replacement,
+                                         retire_fn=_request_drain,
+                                         min_fleet=max(
+                                             1, self.num_workers // 2))
+        self.fleet_controller = controller
+
         def worker_once(idx: int, start_epoch: int, progress: List[int],
                         losses: List[Any]) -> None:
             """One attempt at a worker's epoch loop, starting at
@@ -936,6 +1021,14 @@ class AsyncDistributedTrainer(Trainer):
                     # the SAME id set its pull asked for
                     next_rows: Optional[List[np.ndarray]] = None
                     for w, (wx_h, wy_h) in enumerate(feed):
+                        if controller is not None:
+                            with fleet_lock:
+                                wants_drain = idx in drain_requests
+                            if wants_drain:
+                                # retire lands at a window BOUNDARY: the
+                                # previous window's commit is already on
+                                # the wire, no new work starts
+                                raise _DrainRequested(idx, w)
                         if self.fault_hook is not None:
                             self.fault_hook(idx, w)
                         telemetry = obs.enabled()
@@ -1075,6 +1168,13 @@ class AsyncDistributedTrainer(Trainer):
                             if time.monotonic() >= h_next:
                                 send_health()
                                 h_next = time.monotonic() + health_interval
+                        if controller is not None:
+                            # fleet-throughput sample (bench pre/post-
+                            # preemption rates); autoscale runs only, so
+                            # the default path appends nothing
+                            with self._window_log_lock:
+                                self._window_log.append(
+                                    (time.monotonic(), idx))
                         # loss stays a device scalar until the run ends:
                         # float() here would add one more blocking round
                         # trip per window
@@ -1087,6 +1187,49 @@ class AsyncDistributedTrainer(Trainer):
                 # prefetches) — commits must be APPLIED before the run's
                 # final center read, not just queued on the wire
                 client.drain()
+            except (WorkerPreempted, _DrainRequested) as stop_ev:
+                # graceful drain (ISSUE 19): finish the in-flight
+                # exchange — pipelined commit acks plus the unused
+                # prefetched pull — then flush the int8 residual so
+                # error feedback is not lost with the worker, and leave
+                # through the normal BYE in the finally below.  The hub
+                # sees a voluntary departure (elastic denominators
+                # shrink through member_leave), never a torn stream, and
+                # every acked commit is already in the center: zero
+                # acked-commit loss by construction
+                clean = True
+                outstanding = 0
+                try:
+                    client.drain()
+                    if self.compress_commits == "int8" and not sparse_on:
+                        # the residual chain advances at quantization
+                        # time, so one zero-delta commit carries exactly
+                        # the accumulated residual
+                        client.commit([np.zeros_like(t) for t in flat0])
+                except Exception:
+                    clean = False
+                    pend = getattr(client, "_pending", None)
+                    outstanding = len(pend) if pend is not None else -1
+                if isinstance(stop_ev, WorkerPreempted):
+                    with fleet_lock:
+                        self.worker_preemptions.append({
+                            "worker": idx, "window": stop_ev.window,
+                            "deadline_s": stop_ev.deadline_s,
+                            "drained_clean": clean,
+                            "outstanding_after_drain": outstanding})
+                    if obs.enabled():
+                        obs.counter("worker.preemptions").inc()
+                    if controller is not None:
+                        controller.notify_drained(idx, clean=clean)
+                    raise  # the supervisor respawns, budget-neutral
+                # controller-requested retire: record, then exit as a
+                # finished worker — the supervisor must not restart it
+                with fleet_lock:
+                    drain_requests.discard(idx)
+                    drained.add(idx)
+                if controller is not None:
+                    controller.notify_drained(idx, clean=clean)
+                return
             finally:
                 client.close()
         def run_worker(idx: int) -> None:
@@ -1094,6 +1237,8 @@ class AsyncDistributedTrainer(Trainer):
             start_counted = obs.enabled()
             if start_counted:
                 m_started.inc()
+            if controller is not None:
+                controller.notify_worker_started(idx)
             progress = [0, 0]  # [resume epoch, losses length at its start]
             try:
                 while True:
@@ -1101,6 +1246,18 @@ class AsyncDistributedTrainer(Trainer):
                         worker_once(idx, progress[0], progress, losses)
                         return
                     except BaseException as e:
+                        if (isinstance(e, WorkerPreempted)
+                                and controller is not None
+                                and controller.notify_preempted(
+                                    idx, deadline_s=e.deadline_s)):
+                            # planned capacity loss, already drained
+                            # clean: the authorized respawn re-enters at
+                            # the interrupted epoch WITHOUT burning a
+                            # restart-budget slot (a preemption is not a
+                            # crash), re-pulling the hub's CURRENT center
+                            # like any restart
+                            del losses[progress[1]:]
+                            continue
                         # supervision: "restart" re-runs the worker from the
                         # hub's CURRENT center (its committed progress
                         # survives there), bounded by max_worker_restarts
@@ -1128,6 +1285,14 @@ class AsyncDistributedTrainer(Trainer):
                         if obs.enabled():
                             obs.counter("worker.restarts").inc()
             finally:
+                if controller is not None:
+                    controller.notify_worker_exited(idx)
+                    with fleet_lock:
+                        # retired workers stay out of the respawn pool —
+                        # re-admitting the drifting worker the controller
+                        # just drained would undo the retire
+                        if idx not in drained:
+                            exited_workers.add(idx)
                 if start_counted:
                     m_finished.inc()
                 # flush even on a mid-run crash: windows whose commits
@@ -1162,8 +1327,17 @@ class AsyncDistributedTrainer(Trainer):
         with self._profile_ctx():
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join()
+            # spawned replacements append to `threads` mid-join (fleet
+            # controller): keep joining until a pass finds no new threads
+            joined = 0
+            while True:
+                with fleet_lock:
+                    batch = threads[joined:]
+                if not batch:
+                    break
+                for t in batch:
+                    t.join()
+                joined += len(batch)
         if snap_stop is not None:
             snap_stop.set()
             snap_thread.join(timeout=10)
@@ -1177,6 +1351,8 @@ class AsyncDistributedTrainer(Trainer):
                 if not errors and self.on_worker_failure == "raise":
                     raise
                 errors.append(snap_err)  # recorded in worker_errors below
+        if controller is not None:
+            controller.stop()
         if ps is not None:
             ps.stop()
         self._cleanup_shm_dir()

@@ -391,6 +391,57 @@ class InjectedWorkerFault(RuntimeError):
     one and not an incidental bug."""
 
 
+class WorkerPreempted(RuntimeError):
+    """The notice :class:`SpotPreemptionPlan` delivers — the in-process
+    analog of SIGTERM-with-a-deadline from a spot/preemptible scheduler.
+    Unlike :class:`InjectedWorkerFault` (the SIGKILL analog) the worker
+    is expected to DRAIN: finish in-flight commits, flush residuals,
+    send BYE within ``deadline_s``, and let the supervisor respawn a
+    replacement against the current center."""
+
+    def __init__(self, worker: int, window: int, deadline_s: float):
+        super().__init__(
+            f"spot preemption notice: worker {worker} at window {window}, "
+            f"drain deadline {deadline_s:g}s")
+        self.worker = int(worker)
+        self.window = int(window)
+        self.deadline_s = float(deadline_s)
+
+
+class SpotPreemptionPlan:
+    """Deterministic planned-preemption drill (ISSUE 19) for the
+    trainers' ``fault_hook``: raises :class:`WorkerPreempted` the first
+    time a planned ``(worker, window)`` boundary is reached — and never
+    again for that pair, so the respawned replacement replaying the same
+    window proceeds.  Thread-safe (each worker runs its own thread).
+
+    The trainer's autoscale path catches the notice, drains the client
+    gracefully (every in-flight commit acked, int8 residuals flushed,
+    BYE sent), records the drain in ``worker_preemptions``, and
+    respawns — planned preemptions do not count against
+    ``max_worker_restarts``."""
+
+    def __init__(self, preemptions: Sequence[Tuple[int, int]] = (),
+                 deadline_s: float = 5.0):
+        self.preemptions: Set[Tuple[int, int]] = {
+            (int(w), int(k)) for w, k in preemptions}
+        self.deadline_s = float(deadline_s)
+        self.fired: List[Tuple[int, int]] = []
+        # monotonic timestamp per firing, aligned with ``fired`` — the
+        # bench splits its throughput window log on these
+        self.fired_at: List[float] = []
+        self._lock = threading.Lock()
+
+    def hook(self, worker: int, window: int) -> None:
+        """Pass as ``fault_hook=plan.hook``."""
+        key = (worker, window)
+        with self._lock:
+            if key in self.preemptions and key not in self.fired:
+                self.fired.append(key)
+                self.fired_at.append(time.monotonic())
+                raise WorkerPreempted(worker, window, self.deadline_s)
+
+
 class HubKillPlan:
     """Deterministic kill-primary drill (ISSUE 7): crash a hub —
     ``hub.kill()``, the SIGKILL-equivalent teardown — once it has applied
@@ -500,5 +551,6 @@ class ShardedChaosProxy:
 
 __all__ = [
     "Fault", "FaultPlan", "ChaosProxy", "ShardedChaosProxy", "WorkerKillPlan",
-    "HubKillPlan", "InjectedWorkerFault", "SEVER", "DELAY", "TRUNCATE",
+    "HubKillPlan", "InjectedWorkerFault", "SpotPreemptionPlan",
+    "WorkerPreempted", "SEVER", "DELAY", "TRUNCATE",
 ]

@@ -292,7 +292,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     ``open(path, 'wb').write(Model.init(spec).serialize())``.
     """
     import argparse
-    import time
+    import threading
 
     parser = argparse.ArgumentParser(description="dist-keras-tpu parameter-server daemon")
     parser.add_argument("--model", required=True, help="serialized Model file")
@@ -363,6 +363,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "its applied commits, promote on its death "
                              "(both hubs; sharded: one standby daemon "
                              "per shard, paired with --shard-index)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run an ADVISORY FleetController on this hub's "
+                             "health monitor: spawn/retire/respawn "
+                             "decisions are recorded and counted "
+                             "(ps_fleet_* telemetry, printed at shutdown) "
+                             "for an operator or supervisor to act on — "
+                             "the daemon itself starts no workers")
     args = parser.parse_args(argv)
     if args.restore and not args.snapshot_dir:
         parser.error("--restore requires --snapshot-dir")
@@ -398,6 +405,20 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     with open(args.model, "rb") as f:
         model = Model.deserialize(f.read())
+    # graceful preemption drain (ISSUE 19): SIGTERM — the notice every
+    # spot/preemptible scheduler sends ahead of the kill — exits the wait
+    # loop below and runs the SAME shutdown as Ctrl-C.  Installed BEFORE
+    # the hub starts (and before the "listening" banner): a supervisor
+    # that SIGTERMs the moment the daemon reports ready must get the
+    # drain, never the default-action kill
+    import signal
+
+    stop_event = threading.Event()
+
+    def _on_sigterm(_signum, _frame):
+        stop_event.set()
+
+    prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
     ps = start_parameter_server(model, mode=args.mode, num_workers=args.num_workers,
                                 host=args.host, port=args.port, native=args.native,
                                 elastic=args.elastic,
@@ -426,12 +447,33 @@ def main(argv: Optional[List[str]] = None) -> None:
               f"{args.host}:{ps.port}", flush=True)
     else:
         print(f"ps listening on {args.host}:{ps.port}", flush=True)
+    controller = None
+    if args.autoscale:
+        from distkeras_tpu.observability import health as _health
+        from distkeras_tpu.runtime.fleet_controller import FleetController
+
+        controller = FleetController(_health.monitor())
+    # the drain itself: ps.stop() takes a final snapshot, flushes and
+    # severs the replication feed (a standby's stream ends with a clean
+    # EOF, never a torn frame), shuts the listener down and severs worker
+    # connections.  Workers reconnect to the standby/restart under their
+    # own budgets; nothing acked is lost
     try:
-        while True:
-            time.sleep(1)
+        while not stop_event.wait(1.0):
+            pass
     except KeyboardInterrupt:
         pass
     finally:
+        signal.signal(signal.SIGTERM, prev_handler)
+        if stop_event.is_set():
+            print("SIGTERM: draining hub (final snapshot, feed flush, "
+                  "listener shutdown)", flush=True)
+        if controller is not None:
+            controller.stop()
+            for d in controller.decisions():
+                print(f"fleet decision: {d['action']} "
+                      f"worker={d['worker']} reason={d['reason']}",
+                      flush=True)
         ps.stop()
         # distributed tracing: the hub process is the merge's clock
         # REFERENCE (offset 0) — flush its spans (handler-side

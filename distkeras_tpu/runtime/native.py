@@ -610,6 +610,19 @@ class NativeParameterServer:
         it) — the Python adaptive hub's attribute, served from C++."""
         return self.stats()["backpressure_hints"]
 
+    def fleet_info(self) -> Dict[str, Any]:
+        """Fleet/admission snapshot in the Python hubs' ``fleet_info``
+        shape.  The C++ hub does not namespace jobs (job-scoped T
+        announces are a Python-hub feature; un-upgraded hubs reply with
+        the plain time payload and the client treats that as a wire
+        error), so the jobs block is always empty — callers see one
+        uniform dict either way."""
+        s = self.stats()
+        return {"live_workers": int(s.get("live_workers", 0)),
+                "jobs": {}, "clock": int(s.get("clock", 0)),
+                "num_updates": int(s.get("commits", 0)),
+                "jobs_admitted": 0, "jobs_rejected": 0}
+
     def time_ns(self) -> int:
         """The hub's CLOCK_MONOTONIC in ns — the same epoch Python's
         ``time.perf_counter_ns`` reads on Linux (offset sanity checks)."""

@@ -515,6 +515,30 @@ def decode_time_payload(blobs: Sequence) -> int:
     return t_ns
 
 
+def encode_admission_payload(t_ns: int, admitted: bool,
+                             reason: str = "") -> bytes:
+    """The hub->client ``T`` reply to a job-scoped announce (ISSUE 19):
+    a tensor frame whose single blob is the UTF-8 JSON admission verdict
+    ``{"t", "admitted", "reason"}``.  Only sent to a client that put a
+    ``job_ns`` key on its announce — a plain trace announce keeps the
+    8-byte :func:`encode_time_payload` reply, byte-identical to HEAD."""
+    doc = json.dumps({"t": int(t_ns), "admitted": bool(admitted),
+                      "reason": reason}).encode("utf-8")
+    return encode_tensors(ACTION_TRACE, [np.frombuffer(doc, np.uint8)])
+
+
+def decode_admission_payload(blobs: Sequence) -> Tuple[int, bool, str]:
+    """Inverse of :func:`encode_admission_payload` given the decoded blob
+    list: ``(t_ns, admitted, reason)``."""
+    if not blobs:
+        raise ProtocolError("T admission reply carries no blob")
+    try:
+        doc = json.loads(bytes(memoryview(blobs[0])).decode("utf-8"))
+        return int(doc["t"]), bool(doc["admitted"]), str(doc.get("reason", ""))
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as ex:
+        raise ProtocolError(f"malformed T admission reply: {ex}")
+
+
 # -- reconnect backpressure (actions G / Y) -----------------------------------
 
 def encode_reconnect_payload(waits_taken: int) -> bytes:

@@ -823,6 +823,42 @@ class _AdaptiveCombiner:
             entry["done"] = True
 
 
+class JobAdmissionError(net.ProtocolError):
+    """The hub rejected a client's job-scoped announce (ISSUE 19): the
+    shard's job slots or memory/throughput budget are exhausted.  A
+    distinct type so callers can tell an admission verdict from a torn
+    stream; it still subclasses ``ProtocolError``, so a mid-run
+    re-announce rejection (reconnect landing on a full hub) rides the
+    normal retry/rotate machinery instead of escaping uncaught."""
+
+    def __init__(self, job: str, reason: str):
+        super().__init__(f"job {job!r} admission rejected: {reason}")
+        self.job = job
+        self.reason = reason
+
+
+class _JobState:
+    """One admitted non-default job namespace (ISSUE 19): a private copy
+    of the center (seeded from the hub's center at admission time) with
+    its own commit clock.  Every field is guarded by the owning hub's
+    center lock — job commits take the SAME lock as default-job commits,
+    so fairness is lock-scheduling fairness, and a job's state can never
+    tear against an admission or a snapshot cut.
+
+    Deliberately OUTSIDE the adaptive combiner, replication feed and
+    snapshot plane: isolation is the point of the namespace — one job's
+    machinery must not move another job's latency — and HA/persistence
+    for secondary jobs is future work (documented in MIGRATION.md)."""
+
+    __slots__ = ("job", "center", "clock", "num_updates")
+
+    def __init__(self, job: str, center: Sequence[np.ndarray]):
+        self.job = job
+        self.center = [np.array(w, dtype=np.float32) for w in center]
+        self.clock = 0
+        self.num_updates = 0
+
+
 class SocketParameterServer:
     """Hub-and-spoke PS: one handler thread per worker connection, one lock
     around the center variable — the reference's concurrency model
@@ -867,7 +903,9 @@ class SocketParameterServer:
                  sparse_leaves: Sequence[int] = (),
                  adaptive: bool = False,
                  shm_dir: Optional[str] = None,
-                 recv_batch_depth: int = 0):
+                 recv_batch_depth: int = 0,
+                 max_jobs: int = 4,
+                 job_budget_bytes: Optional[int] = None):
         self.center: List[np.ndarray] = [np.array(w, dtype=np.float32) for w in weights]
         self.host = host
         self.port = int(port)
@@ -956,6 +994,22 @@ class SocketParameterServer:
         # of one per frame.  0 (the default) keeps the per-frame
         # recv_frame_into path untouched
         self.recv_batch_depth = max(0, int(recv_batch_depth))
+        # multi-job service (ISSUE 19): a session that puts a ``job_ns``
+        # key on its T announce gets an admission-controlled private
+        # center namespace (dense P/C/Q only).  Admission projects the
+        # shard's memory cost — one center copy per job plus the decayed
+        # hot-row working set from the PR-14 touch counters — against
+        # ``job_budget_bytes`` (default 4x the center) and caps the job
+        # count at ``max_jobs``.  A session that never announces a
+        # job_ns rides the default namespace: the hub's own center,
+        # byte-for-byte the pre-multi-job exchange
+        self.max_jobs = max(0, int(max_jobs))
+        self.job_budget_bytes = (4 * max(1, self._center_bytes)
+                                 if job_budget_bytes is None
+                                 else int(job_budget_bytes))
+        self._jobs: Dict[str, _JobState] = {}  # under _lock
+        self.jobs_admitted = 0
+        self.jobs_rejected = 0
         self._conn_seq = 0  # connection ordinal -> staleness gauge label
         # half-open liveness: a peer that dies without FIN used to park its
         # handler in recv() forever.  With idle_timeout set, a connection
@@ -1640,6 +1694,100 @@ class SocketParameterServer:
             self._feed.publish(commit_clock, scaled)
         return staleness, last_pull_clock
 
+    # -- multi-job admission + job-scoped serving (ISSUE 19) -------------------
+
+    def _job_working_set_bytes_locked(self) -> int:
+        """The shard's decayed hot-row working set in bytes (caller holds
+        the center lock): rows still at or above ``TOUCH_HOT_MIN`` in the
+        PR-14 touch counters, times their row bytes.  This is the live
+        per-job memory signal admission projects against the budget —
+        a shard whose embedding hot set already fills memory must not
+        also take on another job's center copy."""
+        total = 0
+        for leaf, touch in self._sparse_touch.items():
+            hot = int(np.count_nonzero(touch >= self.TOUCH_HOT_MIN))
+            total += hot * int(self.center[leaf].shape[1]) * 4
+        return total
+
+    def _admit_job(self, job: str) -> Tuple[bool, str, Optional[_JobState]]:
+        """Admission-control one job-scoped announce.  Returns
+        ``(admitted, reason, state)``; re-announcing an already-admitted
+        job (a reconnecting worker) re-attaches to the existing
+        namespace.  The verdict settles under the center lock BEFORE any
+        pull/commit is served on the announcing connection
+        (``FLEET_RULES.admission_before_attach``)."""
+        job = str(job)
+        reason = ""
+        with self._lock:
+            state = self._jobs.get(job)
+            n_jobs = len(self._jobs)
+            if state is None:
+                if self._standby and not self.promoted:
+                    reason = ("standby hubs hold no job namespaces "
+                              "(admission is primary-only)")
+                elif self.max_jobs <= 0:
+                    reason = "multi-job serving is disabled (max_jobs=0)"
+                elif n_jobs >= self.max_jobs:
+                    reason = f"job slots exhausted ({n_jobs}/{self.max_jobs})"
+                else:
+                    ws = self._job_working_set_bytes_locked()
+                    projected = self._center_bytes * (n_jobs + 1) + ws
+                    if projected > self.job_budget_bytes:
+                        reason = (
+                            f"shard memory budget exceeded: projected "
+                            f"{projected} bytes ({n_jobs + 1} job center "
+                            f"copies + {ws}-byte hot working set) > "
+                            f"budget {self.job_budget_bytes}")
+                    else:
+                        state = _JobState(job, self.center)
+                        self._jobs[job] = state
+                        self.jobs_admitted += 1
+                        n_jobs += 1
+            if state is None:
+                self.jobs_rejected += 1
+        if obs.enabled():
+            if state is not None:
+                obs.counter("ps_jobs_admitted_total", **self._mlabels).inc()
+                obs.gauge("ps_active_jobs", **self._mlabels).set(n_jobs)
+            else:
+                obs.counter("ps_jobs_rejected_total", **self._mlabels).inc()
+        return (state is not None), reason, state
+
+    def _job_commit_one(self, state: _JobState, delta: Sequence[np.ndarray],
+                        last_pull_clock: int) -> Tuple[int, int]:
+        """Job-scoped twin of :meth:`_commit_one`: same staleness and
+        ``commit_scale`` semantics (the hub flavor's rule — ADAG's
+        membership-weighted denominator, DynSGD's ``1/(s+1)``) applied
+        to the JOB's center under the SAME center lock.  No adaptive
+        combiner, replication or snapshot participation — isolation is
+        the contract (see :class:`_JobState`)."""
+        with self._lock:
+            staleness = state.clock - last_pull_clock
+            scale = self.commit_scale(staleness)
+            for c, d in zip(state.center, delta):
+                if scale == 1.0:
+                    c += d
+                else:
+                    c += d * scale
+            state.num_updates += 1
+            state.clock += 1
+        return staleness, last_pull_clock
+
+    def fleet_info(self) -> Dict[str, Any]:
+        """The hub's membership/job surface (ISSUE 19) — one JSON-safe
+        dict the fleet controller, ``distkeras-top`` and the launcher
+        all read.  The native hub's wrapper maps its C++ stat keys onto
+        the same shape, so callers never branch on hub implementation."""
+        with self._lock:
+            jobs = {name: {"clock": s.clock, "num_updates": s.num_updates}
+                    for name, s in self._jobs.items()}
+            clock = self._clock
+            num_updates = self.num_updates
+            admitted, rejected = self.jobs_admitted, self.jobs_rejected
+        return {"live_workers": self.live_workers(), "jobs": jobs,
+                "clock": clock, "num_updates": num_updates,
+                "jobs_admitted": admitted, "jobs_rejected": rejected}
+
     def _retry_after_ms(self, waits_taken: int = 0) -> int:
         """Answer one reconnect hello (action ``G``): 0 = proceed now,
         else the caller's retry-after slot in milliseconds.  Every hub
@@ -1913,6 +2061,14 @@ class SocketParameterServer:
         # announces): every span this handler records is tagged with it,
         # so hub-side work is attributable to the worker that caused it
         ctx_attrs: Dict[str, Any] = {}
+        # multi-job (ISSUE 19): set when this connection's T announce
+        # carried a job_ns key and the admission verdict settled.  A
+        # rejected session is never served (FLEET_RULES.
+        # reject_never_serves); an admitted one is routed to its job's
+        # private center with its own pull clock
+        job_state: Optional[_JobState] = None
+        job_rejected = False
+        job_pull_clock = 0
         # per-connection reusable storage: the receive buffer grows once to
         # the largest frame this worker sends (a commit), the reply codec
         # holds one prepacked weights frame, the ack is a 13-byte constant
@@ -1972,6 +2128,28 @@ class SocketParameterServer:
                 telemetry = obs.enabled()
                 t0 = time.perf_counter() if telemetry else 0.0
                 if action == net.ACTION_PULL:
+                    if job_rejected:
+                        raise net.ProtocolError(
+                            "pull on a rejected job session refused "
+                            "(the admission verdict was reject)")
+                    if job_state is not None:
+                        with obs.span("ps.handle_pull", conn=conn_idx,
+                                      **self._shard_attrs, **ctx_attrs):
+                            with self._lock:
+                                reply.pack(net.ACTION_WEIGHTS,
+                                           job_state.center)
+                                job_pull_clock = job_state.clock
+                            reply.send_packed(conn)
+                        if telemetry:
+                            obs.counter("ps_pulls_total",
+                                        **self._mlabels).inc()
+                            obs.counter("ps_pull_bytes_total",
+                                        **self._mlabels).inc(
+                                self._center_bytes)
+                            obs.histogram("ps_rpc_seconds", rpc="pull",
+                                          **self._mlabels).observe(
+                                time.perf_counter() - t0)
+                        continue
                     if self._standby and not self._synced.is_set():
                         # same rule as commits: seed weights must never be
                         # served as if they were the job's state — a
@@ -1998,9 +2176,41 @@ class SocketParameterServer:
                                       **self._mlabels).observe(
                             time.perf_counter() - t0)
                 elif action in (net.ACTION_COMMIT, net.ACTION_QCOMMIT):
+                    if job_rejected:
+                        raise net.ProtocolError(
+                            "commit on a rejected job session refused "
+                            "(the admission verdict was reject)")
                     delta = (self._decode_delta(blobs)
                              if action == net.ACTION_COMMIT
                              else self._decode_qdelta(blobs))
+                    if job_state is not None:
+                        if not joined:
+                            joined = True
+                            self._member_join(member_token)
+                        with obs.span("ps.handle_commit", conn=conn_idx,
+                                      **self._shard_attrs,
+                                      **ctx_attrs) as sp:
+                            staleness, job_pull_clock = self._job_commit_one(
+                                job_state, delta, job_pull_clock)
+                            net.send_raw_frame(conn, ack)
+                            if getattr(sp, "attrs", None) is not None:
+                                sp.attrs["staleness"] = staleness
+                        self._observe_health(ctx_attrs.get("worker"),
+                                             "staleness", staleness)
+                        if telemetry:
+                            obs.counter("ps_commits_total",
+                                        **self._mlabels).inc()
+                            obs.counter("ps_commit_bytes_total",
+                                        **self._mlabels).inc(
+                                sum(b.nbytes for b in blobs))
+                            obs.histogram("ps_rpc_seconds", rpc="commit",
+                                          **self._mlabels).observe(
+                                time.perf_counter() - t0)
+                            obs.gauge("ps_staleness", conn=str(conn_idx),
+                                      **self._mlabels).set(staleness)
+                            obs.histogram("ps_commit_staleness",
+                                          **self._mlabels).observe(staleness)
+                        continue
                     if self._standby:
                         if not self._synced.is_set():
                             # no sync ever landed: this standby holds
@@ -2065,6 +2275,10 @@ class SocketParameterServer:
                         obs.histogram("ps_commit_staleness",
                                       **self._mlabels).observe(staleness)
                 elif action == net.ACTION_SPARSE_PULL:
+                    if job_state is not None or job_rejected:
+                        raise net.ProtocolError(
+                            "sparse actions are default-namespace only "
+                            "(job-scoped sessions exchange dense P/C/Q)")
                     if sp_enc is None:
                         raise net.ProtocolError(
                             "sparse pull against a hub with no sparse "
@@ -2113,6 +2327,10 @@ class SocketParameterServer:
                             time.perf_counter() - t0)
                 elif action in (net.ACTION_SPARSE_COMMIT,
                                 net.ACTION_SPARSE_QCOMMIT):
+                    if job_state is not None or job_rejected:
+                        raise net.ProtocolError(
+                            "sparse actions are default-namespace only "
+                            "(job-scoped sessions exchange dense P/C/Q)")
                     if not self.sparse_leaves:
                         raise net.ProtocolError(
                             "sparse commit against a hub with no sparse "
@@ -2172,16 +2390,45 @@ class SocketParameterServer:
                     # offset estimate is built from).  Malformed context is
                     # ignored, not fatal — tracing must never take down a
                     # training connection
+                    raw = bytes(blobs[0]) if blobs else b""
                     try:
-                        ctx = dtrace.TraceContext.from_json(bytes(blobs[0]))
+                        ctx = dtrace.TraceContext.from_json(raw)
                         ctx_attrs = ctx.span_attrs()
                     except Exception:
                         # any malformed blob shape (missing blob, non-object
                         # JSON, null fields -> TypeError/AttributeError):
                         # an unattributed connection, never a dropped one
                         ctx_attrs = {}
-                    net.send_frame(conn, net.encode_time_payload(
-                        time.perf_counter_ns()))
+                    # multi-job announce (ISSUE 19): a job_ns key turns
+                    # this T into a job-scoped announce whose reply is the
+                    # admission verdict.  Absent (every pre-multi-job
+                    # client), the reply below is the exact HEAD timestamp
+                    # frame — byte-identical wire
+                    job_ns = None
+                    try:
+                        doc = json.loads(raw.decode("utf-8"))
+                        if isinstance(doc, dict):
+                            job_ns = doc.get("job_ns")
+                    except Exception:
+                        job_ns = None
+                    if job_ns is None:
+                        if job_state is not None:
+                            # a later plain trace announce on an admitted
+                            # session must not drop the job attribution
+                            ctx_attrs["job"] = job_state.job
+                        net.send_frame(conn, net.encode_time_payload(
+                            time.perf_counter_ns()))
+                    else:
+                        admitted, reason, job_state = self._admit_job(
+                            str(job_ns))
+                        job_rejected = not admitted
+                        if admitted:
+                            # the namespace IS the job for every span and
+                            # health series this connection produces —
+                            # fairness reporting groups by it
+                            ctx_attrs["job"] = job_state.job
+                        net.send_frame(conn, net.encode_admission_payload(
+                            time.perf_counter_ns(), admitted, reason))
                 elif action == net.ACTION_REPL:
                     # replica handshake: this peer is a hot standby, not a
                     # worker.  Attach it to the replication feed (full
@@ -3143,7 +3390,8 @@ class PSClient(_HotTierCacheSurface):
                  sparse_leaves: Sequence[int] = (),
                  adaptive: bool = False,
                  sparse_cache_rows: Optional[int] = None,
-                 shm: bool = False):
+                 shm: bool = False,
+                 job: Optional[str] = None):
         if compress not in (None, "int8"):
             raise ValueError(f"unknown compress {compress!r}; use None or 'int8'")
         self.templates = [np.asarray(t, dtype=np.float32) for t in templates]
@@ -3259,6 +3507,12 @@ class PSClient(_HotTierCacheSurface):
         # moves, the byte stream is exactly the pre-adaptive one
         self.adaptive = bool(adaptive)
         self.backpressure_waits = 0
+        # multi-job namespace (ISSUE 19): job="name" announces a job_ns
+        # key on a T frame at every (re)connect and trains against the
+        # hub's admission-controlled private center for that job.  None
+        # (default): no announce — the default namespace, byte-identical
+        # to the pre-multi-job client
+        self.job = None if job is None else str(job)
         # zero-copy shm transport (ISSUE 18): shm=True asks every fresh
         # connection for an shm attach (action Z).  The hub offers a ring
         # pair (same host, shm armed) or declines; a LEGACY hub closing
@@ -3300,6 +3554,20 @@ class PSClient(_HotTierCacheSurface):
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._ping_frame = net.empty_tensor_frame(net.ACTION_PING)
+        # job announce first (ISSUE 19): the admission verdict must
+        # settle before ANY other traffic — a rejected job fails loudly
+        # at construction instead of training on the default center.
+        # Same failure contract as the trace announce below: close the
+        # socket, leave a closeable object, re-raise
+        if self.job is not None:
+            try:
+                self._announce_job()
+            except BaseException:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise
         # announce AFTER every attribute exists (a failed announce —
         # e.g. tracing enabled against a pre-T hub — must leave an object
         # whose close() works) and BEFORE the heartbeat thread starts
@@ -3317,6 +3585,24 @@ class PSClient(_HotTierCacheSurface):
             self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                                daemon=True)
             self._hb_thread.start()
+
+    # -- multi-job namespace (ISSUE 19) ----------------------------------------
+    def _announce_job(self) -> None:
+        """Send the job-scoped T announce (a ``job_ns`` JSON key) and
+        settle the admission verdict.  Runs on a freshly-connected
+        socket before any pipelined traffic — the strict reply FIFO is
+        never disturbed — and raises :class:`JobAdmissionError` on a
+        reject, so a rejected job can never be silently served the
+        default center."""
+        doc = json.dumps({"job_ns": self.job}).encode("utf-8")
+        net.send_frame(self.sock, net.encode_context_payload(doc))
+        action, blobs = net.recv_tensors(self.sock)
+        if action != net.ACTION_TRACE:
+            raise net.ProtocolError(
+                f"expected T reply to job announce, got {action!r}")
+        _t_ns, admitted, reason = net.decode_admission_payload(blobs)
+        if not admitted:
+            raise JobAdmissionError(self.job, reason)
 
     # -- distributed tracing ---------------------------------------------------
     def _announce_and_sync(self, rounds: int = 3) -> None:
@@ -3611,6 +3897,12 @@ class PSClient(_HotTierCacheSurface):
                     # with shm off, a remote failover target — is a
                     # degrade, not a fault
                     self._maybe_attach_shm()
+                    # re-announce the job namespace (admission is
+                    # per-connection; a restarted hub re-admits, a full
+                    # or standby hub rejects — a ProtocolError here
+                    # rotates to the next address under the same budget)
+                    if self.job is not None:
+                        self._announce_job()
                     # re-announce the trace context on the fresh
                     # connection (a restarted hub has no memory of the
                     # old one) and refresh the clock-offset estimate

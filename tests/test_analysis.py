@@ -1134,6 +1134,26 @@ def test_protocol_shm_attach_model_checks_handshake():
             f"flipping {rule} produced no {needle} finding"
 
 
+def test_protocol_fleet_model_checks_join_drain_admission():
+    """The fleet join/drain/admission machine (ISSUE 19): the shipped
+    rules settle every interleaving clean, and flipping each safety rule
+    produces its named failure — a rejected job observing state, an
+    acked commit lost across a drain, a respawn committing blind, a
+    retire racing its drain."""
+    assert not protocol_model.explore_fleet()
+    for rule, needle in (
+            ("admission_before_attach", "admission-races-attach"),
+            ("reject_never_serves", "post-reject-served"),
+            ("drain_completes_inflight", "acked-commit-loss"),
+            ("respawn_pulls_current_center", "respawn-blind-commit"),
+            ("retire_after_drain_only", "retire-before-drain")):
+        rules = dict(protocol_model.FLEET_RULES)
+        rules[rule] = False
+        findings = protocol_model.explore_fleet(rules=rules)
+        assert any(needle in f.message for f in findings), \
+            f"flipping {rule} produced no {needle} finding"
+
+
 def test_protocol_model_covers_full_registry():
     """Every registered ACTION_* byte is either a modeled request or a
     modeled reply — a 17th action must extend the model in the same PR

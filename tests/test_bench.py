@@ -498,6 +498,88 @@ def test_adaptive_acceptance_block_tripwires():
     assert out["acceptance"]["adaptive_wall_ratio"] is None
 
 
+def test_spot_preemption_acceptance_block_tripwires():
+    """The ISSUE-19 tripwires: preemption_recovered_ok pins every planned
+    notice fired + respawned with zero operator input and >= 90% of the
+    pre-preemption windows/s restored; drain_zero_loss_ok separately pins
+    that every drain completed clean with nothing outstanding.  Both
+    None-degrade when the leg errored or never measured a rate."""
+    sp = {
+        "workers": 6, "preempt": 2, "preemptions_fired": 2,
+        "drains": [{"worker": 4, "drained_clean": True,
+                    "outstanding_after_drain": 0},
+                   {"worker": 5, "drained_clean": True,
+                    "outstanding_after_drain": 0}],
+        "drains_clean": True, "outstanding_after_drain": 0,
+        "respawns": 2, "pre_rate_windows_s": 100.0,
+        "post_rate_windows_s": 95.0, "restarts": 0, "worker_errors": 0,
+    }
+    out = {
+        "fault_free": {"wall_s": 10.0, "final_loss": 2.0},
+        "sever": {"error": "skipped"},
+        "worker_restart": {"error": "skipped"},
+        "spot_preemption": dict(sp),
+    }
+    bench._async_recovery_acceptance(out)
+    acc = out["acceptance"]
+    assert acc["preemption_pre_rate_windows_s"] == 100.0
+    assert acc["preemption_post_rate_windows_s"] == 95.0
+    assert acc["preemption_recovered_ok"] is True
+    assert acc["drain_zero_loss_ok"] is True
+
+    # < 90% throughput restored flips recovered (the acceptance floor)
+    out["spot_preemption"] = dict(sp, post_rate_windows_s=80.0)
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["preemption_recovered_ok"] is False
+    assert out["acceptance"]["drain_zero_loss_ok"] is True
+    # a missing respawn (operator input needed) flips recovered
+    out["spot_preemption"] = dict(sp, respawns=1)
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["preemption_recovered_ok"] is False
+    # an unclean drain or leftover in-flight commit flips zero-loss
+    out["spot_preemption"] = dict(sp, drains_clean=False)
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["drain_zero_loss_ok"] is False
+    out["spot_preemption"] = dict(sp, outstanding_after_drain=1)
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["drain_zero_loss_ok"] is False
+    # a drain that never fired its notice count flips zero-loss too
+    out["spot_preemption"] = dict(sp, drains=sp["drains"][:1])
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["drain_zero_loss_ok"] is False
+
+    # no rate measured -> recovered degrades to None; an errored or
+    # absent leg degrades everything — never a crash
+    out["spot_preemption"] = dict(sp, pre_rate_windows_s=None)
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["preemption_recovered_ok"] is None
+    out["spot_preemption"] = {"error": "RuntimeError: hub fell over"}
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["preemption_recovered_ok"] is None
+    assert out["acceptance"]["drain_zero_loss_ok"] is None
+    assert out["acceptance"]["preemption_pre_rate_windows_s"] is None
+    del out["spot_preemption"]
+    bench._async_recovery_acceptance(out)
+    assert out["acceptance"]["preemption_recovered_ok"] is None
+    assert out["acceptance"]["drain_zero_loss_ok"] is None
+
+
+@pytest.mark.slow
+def test_bench_async_spot_preemption_tiny_e2e():
+    """The spot-preemption bench leg end to end at a CI-scale shape:
+    notices fire, drains complete clean, respawns are budget-neutral."""
+    out = bench._bench_async_spot_preemption(workers=4, preempt=1,
+                                             window=2, batch=16,
+                                             windows_per_epoch=4, epochs=2)
+    assert "error" not in out, out
+    assert out["preemptions_fired"] == 1
+    assert out["drains_clean"] is True
+    assert out["outstanding_after_drain"] == 0
+    assert out["respawns"] >= 1
+    assert out["restarts"] == 0
+    assert out["worker_errors"] == 0
+
+
 @pytest.mark.slow
 def test_bench_async_adaptive_tiny_e2e():
     """The adaptive bench leg end to end at a CI-scale shape: both legs

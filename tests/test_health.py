@@ -577,19 +577,25 @@ def test_transport_meta_folds_and_renders_trans_column():
     from distkeras_tpu.observability.distributed import fleet_report
 
     c = HealthCollector()
-    c.ingest({"worker": "0", "transport": "shm",
+    c.ingest({"worker": "0", "transport": "shm", "job": "expA",
               "metrics": {"windows_total": 3.0}})
-    c.ingest({"worker": "1", "transport": "tcp",
+    c.ingest({"worker": "1", "transport": "tcp", "job": "expB",
               "metrics": {"windows_total": 3.0}})
     c.ingest({"worker": "2", "metrics": {"windows_total": 1.0}})
     assert c.meta("0")["transport"] == "shm"
     assert "transport" not in c.meta("2")
     frame = render_top({"fleet": c.snapshot(), "events": []})
     assert "TRANS" in frame.splitlines()[1]
+    # JOB + fleet-size columns (ISSUE 19): row layout is
+    # WORKER JOB SHARD TRANS ...; the title counts workers and jobs
+    assert "JOB" in frame.splitlines()[1]
+    assert "fleet 3 worker(s), 2 job(s)" in frame.splitlines()[0]
     rows = {line.split()[0]: line for line in frame.splitlines()[2:]}
-    assert rows["0"].split()[2] == "shm"
-    assert rows["1"].split()[2] == "tcp"
-    assert rows["2"].split()[2] == "-"
+    assert rows["0"].split()[1] == "expA"
+    assert rows["2"].split()[1] == "-"
+    assert rows["0"].split()[3] == "shm"
+    assert rows["1"].split()[3] == "tcp"
+    assert rows["2"].split()[3] == "-"
 
     report = fleet_report(events=[], live=c)
     assert report["transport"] == {
